@@ -1,0 +1,130 @@
+"""ramba_tpu — a TPU-native distributed NumPy.
+
+Ground-up rebuild of the capabilities of the reference system (Ramba,
+/root/reference): a NumPy drop-in whose arrays are partitioned across
+devices, whose operations are deferred and fused, and whose skeletons
+(smap/sreduce/sstencil/scumulative/spmd) expose structured parallelism.
+
+Where the reference fuses into Numba kernels shipped to Ray/MPI worker
+processes over ZMQ queues, this package fuses into single jitted XLA modules
+over `jax.Array`s sharded on a TPU mesh; all communication is ICI/DCN
+collectives inserted by GSPMD or issued explicitly in `shard_map` kernels.
+
+Usage (same shape as the reference README, /root/reference/README.md:39-55):
+
+    import ramba_tpu as np
+    A = np.arange(1_000_000_000) / 1000.0
+    B = np.sin(A)
+    C = np.cos(A)
+    D = B*B + C**2
+    np.sync()
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from ramba_tpu import common  # noqa: F401  (env config; import first)
+from ramba_tpu.core.fuser import flush, sync, stats as fuser_stats  # noqa: F401
+from ramba_tpu.core.masked import MaskedArray  # noqa: F401
+from ramba_tpu.core.ndarray import ndarray  # noqa: F401
+from ramba_tpu.ops.creation import (  # noqa: F401
+    arange, array, asarray, copy, empty, empty_like, eye, fromarray,
+    fromfunction, full, full_like, identity, indices, init_array, linspace,
+    meshgrid, mgrid, ones, ones_like, tri, zeros, zeros_like,
+)
+from ramba_tpu.ops.elementwise import *  # noqa: F401,F403
+from ramba_tpu.ops.elementwise import (  # noqa: F401
+    allclose, array_equal, cbrt, clip, isclose, select, where,
+)
+from ramba_tpu.ops.reductions import (  # noqa: F401
+    all, amax, amin, any, argmax, argmin, average, count_nonzero, cumprod,
+    cumsum, max, mean, median, min, nanmax, nanmean, nanmin, nanprod, nanstd,
+    nansum, nanvar, prod, ptp, std, sum, var,
+)
+from ramba_tpu.ops.manipulation import (  # noqa: F401
+    argsort, array_split, atleast_1d, atleast_2d, broadcast_to, column_stack,
+    concatenate, diag, dstack, expand_dims, flip, hstack, moveaxis, pad,
+    ravel, repeat, reshape, roll, sort, split, squeeze, stack, swapaxes,
+    take, tile, transpose, tril, triu, vstack,
+)
+from ramba_tpu.ops.linalg import (  # noqa: F401
+    dot, einsum, inner, matmul, outer, set_matmul_precision, tensordot,
+    trace, vdot,
+)
+from ramba_tpu.parallel.mesh import (  # noqa: F401
+    get_mesh, num_workers, set_mesh,
+)
+from ramba_tpu import random  # noqa: F401
+
+# -- numpy namespace constants / dtypes --------------------------------------
+newaxis = None
+pi = _np.pi
+e = _np.e
+inf = _np.inf
+nan = _np.nan
+euler_gamma = _np.euler_gamma
+
+bool_ = _np.bool_
+int8 = _np.int8
+int16 = _np.int16
+int32 = _np.int32
+int64 = _np.int64
+uint8 = _np.uint8
+uint16 = _np.uint16
+uint32 = _np.uint32
+uint64 = _np.uint64
+float16 = _np.float16
+float32 = _np.float32
+float64 = _np.float64
+complex64 = _np.complex64
+complex128 = _np.complex128
+dtype = _np.dtype
+try:
+    import jax.numpy as _jnp
+
+    bfloat16 = _jnp.bfloat16
+except Exception:  # pragma: no cover
+    pass
+
+float_ = _np.float64
+int_ = _np.int64
+
+
+def init():
+    """Explicit cluster bring-up for API parity (the reference initializes
+    Ray/MPI at import, /root/reference/ramba/common.py:683-758); here the jax
+    backend initializes itself lazily."""
+    get_mesh()
+
+
+def _register_numpy_dispatch():
+    """Populate the __array_function__ registry so `numpy.<fn>(ramba_array)`
+    routes here (reference: generated wrappers, ramba.py:9682-9745)."""
+    from ramba_tpu.core.interop import HANDLED_FUNCTIONS
+
+    import ramba_tpu as _self
+
+    names = [
+        "sum", "prod", "min", "max", "amin", "amax", "mean", "var", "std",
+        "any", "all", "median", "argmin", "argmax", "nansum", "nanmean",
+        "nanmin", "nanmax", "nanprod", "nanvar", "nanstd", "count_nonzero",
+        "cumsum", "cumprod", "average", "ptp",
+        "reshape", "ravel", "transpose", "moveaxis", "swapaxes",
+        "expand_dims", "squeeze", "broadcast_to", "flip", "roll",
+        "concatenate", "stack", "vstack", "hstack", "dstack", "column_stack",
+        "split", "array_split", "pad", "tril", "triu", "diag", "repeat",
+        "tile", "sort", "argsort", "take", "atleast_1d", "atleast_2d",
+        "where", "clip", "select", "isclose", "allclose", "array_equal",
+        "dot", "matmul", "inner", "outer", "tensordot", "einsum", "trace",
+        "vdot", "zeros_like", "ones_like", "empty_like", "full_like", "copy",
+        "asarray",
+    ]
+    for n in names:
+        np_fn = getattr(_np, n, None)
+        ours = getattr(_self, n, None)
+        if np_fn is not None and ours is not None:
+            HANDLED_FUNCTIONS[np_fn] = ours
+
+
+_register_numpy_dispatch()

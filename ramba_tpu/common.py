@@ -1,0 +1,72 @@
+"""Runtime configuration for ramba_tpu.
+
+TPU-native rebuild of the reference's env-var config surface
+(/root/reference/ramba/common.py:26-264).  The reference reads RAMBA_* environment
+variables into module globals at import time and ships them to worker processes;
+here there is a single controller process, so the globals are simply read once.
+
+Unlike the reference there is no backend *selection* between ray/zmq/mpi
+(/root/reference/ramba/common.py:49-100) — the communication substrate is always
+XLA collectives over ICI/DCN, chosen by the device mesh (see parallel/mesh.py).
+A debug backend equivalent to RAMBA_NON_DIST is obtained by running on a single
+device (or a host-platform CPU mesh).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def _env_flag(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name, None)
+    if v is None:
+        return default
+    return v not in ("0", "", "false", "False", "no")
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+# --- debug / timing flags (reference: common.py:102-178) ---------------------
+debug_level = _env_int("RAMBA_DEBUG", 0)
+timing_level = _env_int("RAMBA_TIMING", 0)
+show_code = _env_flag("RAMBA_SHOW_CODE")  # dumps jaxpr/HLO instead of Numba source
+# reference: RAMBA_BIG_DATA switches shard metadata to int64
+# (/root/reference/ramba/shardview_array.py:24-28); here it enables x64 mode.
+big_data = _env_flag("RAMBA_BIG_DATA")
+
+# Arrays smaller than this are replicated rather than sharded
+# (reference: do_not_distribute threshold, /root/reference/ramba/common.py:26,217-218).
+dist_threshold = _env_int("RAMBA_DIST_THRESHOLD", 100)
+
+# Max pending lazy ops before a forced flush (safety valve; the reference DAG is
+# unbounded but practical programs sync often).
+max_pending_ops = _env_int("RAMBA_TPU_MAX_PENDING", 10_000)
+
+# How many mesh axes the default mesh is factored into (1..3).
+mesh_ndim = _env_int("RAMBA_TPU_MESH_NDIM", 1)
+
+# Forced number of devices ("workers"); default = all visible devices.
+num_workers_env = os.environ.get("RAMBA_WORKERS", None)
+
+
+def dprint(level: int, *args) -> None:
+    """Leveled debug print (reference: common.py:168-172)."""
+    if debug_level >= level:
+        print(*args, file=sys.stderr, flush=True)
+
+
+def tprint(level: int, *args) -> None:
+    """Leveled timing print (reference: common.py:174-178)."""
+    if timing_level >= level:
+        print(*args, file=sys.stderr, flush=True)
+
+
+if big_data:
+    # Must run before jax is first used by callers that import common first.
+    os.environ.setdefault("JAX_ENABLE_X64", "1")

@@ -1,0 +1,274 @@
+"""Flush machinery: lazy expression graphs -> one fused, jitted XLA module.
+
+This is the TPU-native counterpart of the reference's two-stage execution
+pipeline:
+
+* ``DAG.execute_all`` — collect every pending node and run it in one batch
+  (/root/reference/ramba/ramba.py:5080-5105), and
+* ``deferred_op.execute`` — emit ONE fused kernel for the batch, name it by a
+  hash of its source for caching, and ship it to all workers
+  (/root/reference/ramba/ramba.py:8115-8316, hash at :8260-8265).
+
+Differences, by design:
+
+* Instead of generating Python source strings for Numba, the expression graph
+  is linearized into a tiny instruction program which is interpreted once
+  under ``jax.jit`` tracing; XLA does the loop fusion and GSPMD inserts the
+  cross-shard collectives (the reference moves boundary data by hand at
+  ramba.py:3549-3694).
+* The compile cache is keyed on program *structure* only — leaf shapes/dtypes
+  are specialized by jax.jit's own cache, and scalar operands are passed as
+  weakly-typed arguments so changing a constant never recompiles.
+* Buffer donation replaces the reference's in-place shard mutation: a leaf
+  buffer that no live ndarray aliases is donated to XLA so e.g. ``a += 1``
+  updates HBM in place (the reference's alias analysis for this is
+  ramba.py:8435-8465).
+"""
+
+from __future__ import annotations
+
+import warnings
+import weakref
+from typing import Optional, Sequence
+
+import jax
+
+from ramba_tpu import common
+from ramba_tpu.core.expr import Const, Expr, Node, Scalar, OPS
+from ramba_tpu.parallel import mesh as _mesh
+
+# Donation is pointless for small buffers and fragments the jit cache (the
+# donate mask is part of the compile key); only donate above this size.
+DONATE_MIN_BYTES = 1 << 20
+
+# ndarrays with a pending (non-Const) expression — the reference keeps the
+# analogous set as DAG nodes ordered by seq_no (ramba.py:4387-4548).
+# Keyed by id() with weakref values: a WeakSet would compare members with
+# ``==``, which on an array type is elementwise and would trigger
+# materialization from inside the registry itself.
+_pending: dict[int, "weakref.ref"] = {}
+
+# id(buffer) -> number of live ndarrays whose materialized value IS that
+# buffer.  Zero owners at flush time means nothing can observe the buffer
+# after this flush, so it is safe to donate.
+_const_owners: dict[int, int] = {}
+
+_nodes_since_flush = 0
+
+# Bounded FIFO compile cache; entries from an old mesh epoch are purged on
+# the first flush after set_mesh (their sharding constraints baked in the old
+# mesh), and user-function keys (fromfunction/apply statics) can't pin
+# unbounded executables.
+_compile_cache: "dict" = {}
+_COMPILE_CACHE_MAX = 512
+_cache_epoch = 0
+
+# Monotone flush counter (observability; cf. reference dag-count history,
+# ramba.py:5120-5128).
+stats = {"flushes": 0, "compiles": 0, "nodes_flushed": 0}
+
+
+def register_pending(arr) -> None:
+    k = id(arr)
+
+    def _cleanup(ref, _k=k):
+        if _pending.get(_k) is ref:
+            del _pending[_k]
+
+    _pending[k] = weakref.ref(arr, _cleanup)
+
+
+def unregister_pending(arr) -> None:
+    _pending.pop(id(arr), None)
+
+
+def _pending_arrays() -> list:
+    out = []
+    for r in list(_pending.values()):
+        a = r()
+        if a is not None:
+            out.append(a)
+    return out
+
+
+def owner_incref(buf) -> None:
+    _const_owners[id(buf)] = _const_owners.get(id(buf), 0) + 1
+
+
+def owner_decref(buf) -> None:
+    k = id(buf)
+    n = _const_owners.get(k, 0) - 1
+    if n <= 0:
+        _const_owners.pop(k, None)
+    else:
+        _const_owners[k] = n
+
+
+def note_node_created() -> None:
+    """Forced-flush safety valve for unbounded build loops."""
+    global _nodes_since_flush
+    _nodes_since_flush += 1
+    if _nodes_since_flush >= common.max_pending_ops:
+        flush()
+
+
+class _Program:
+    """Buffer-free linearization of an expression DAG.
+
+    ``instrs[i] = (op, static, arg_slots)`` where slots < n_leaves index the
+    leaf arguments and later slots index prior instruction results.  Holding
+    no jax.Array references makes the program safe to retain in the compile
+    cache without pinning HBM.
+    """
+
+    __slots__ = ("instrs", "n_leaves", "leaf_kinds", "out_slots", "key")
+
+    def __init__(self, instrs, n_leaves, leaf_kinds, out_slots):
+        self.instrs = instrs
+        self.n_leaves = n_leaves
+        self.leaf_kinds = leaf_kinds
+        self.out_slots = tuple(out_slots)
+        self.key = (tuple(instrs), n_leaves, leaf_kinds, self.out_slots)
+
+
+def _linearize(roots: Sequence[Expr]):
+    """Iterative postorder DFS over the DAG with node dedup (shared subexprs
+    evaluate once — the fusion the reference gets by concatenating codelines
+    into a single loop nest, ramba.py:8348-8423)."""
+    slot: dict[int, int] = {}
+    leaves: list = []
+    instrs: list = []
+    # first pass: collect leaves in deterministic order
+    const_slot: dict[int, int] = {}  # id(buffer) -> leaf slot (dedup aliased)
+    order: list[Expr] = []
+    seen: set[int] = set()
+    stack = [(r, False) for r in reversed(roots)]
+    while stack:
+        node, done = stack.pop()
+        nid = id(node)
+        if done:
+            order.append(node)
+            continue
+        if nid in seen:
+            continue
+        seen.add(nid)
+        if isinstance(node, Node):
+            stack.append((node, True))
+            for a in reversed(node.args):
+                stack.append((a, False))
+        else:
+            order.append(node)
+    for node in order:
+        nid = id(node)
+        if nid in slot:
+            continue
+        if isinstance(node, Const):
+            bid = id(node.value)
+            if bid in const_slot:
+                slot[nid] = const_slot[bid]
+                continue
+            const_slot[bid] = len(leaves)
+            slot[nid] = len(leaves)
+            leaves.append(node)
+        elif isinstance(node, Scalar):
+            slot[nid] = len(leaves)
+            leaves.append(node)
+    n_leaves = len(leaves)
+    for node in order:
+        nid = id(node)
+        if nid in slot or not isinstance(node, Node):
+            continue
+        args = tuple(slot[id(a)] for a in node.args)
+        slot[nid] = n_leaves + len(instrs)
+        instrs.append((node.op, node.static, args))
+    leaf_kinds = tuple("C" if isinstance(l, Const) else "S" for l in leaves)
+    out_slots = [slot[id(r)] for r in roots]
+    return _Program(tuple(instrs), n_leaves, leaf_kinds, out_slots), leaves
+
+
+def _build_callable(program: _Program):
+    instrs = program.instrs
+    n_leaves = program.n_leaves
+    out_slots = program.out_slots
+
+    def run(*leaf_vals):
+        vals = list(leaf_vals)
+        for op, static, argslots in instrs:
+            vals.append(OPS[op](static, *(vals[s] for s in argslots)))
+        return tuple(vals[s] for s in out_slots)
+
+    return run
+
+
+def flush(extra: Sequence[Expr] = ()) -> list:
+    """Materialize every pending ndarray (and ``extra`` expressions) in one
+    fused jit call.  Returns the values of ``extra`` in order."""
+    global _nodes_since_flush
+    _nodes_since_flush = 0
+    roots = [a for a in _pending_arrays() if not isinstance(a._expr, Const)]
+    # Deterministic order across flushes with the same pending set:
+    roots.sort(key=lambda a: a._seq)
+    exprs = [a._expr for a in roots] + list(extra)
+    if not exprs:
+        return []
+    program, leaves = _linearize(exprs)
+
+    donate = []
+    leaf_vals = []
+    for i, leaf in enumerate(leaves):
+        if isinstance(leaf, Const):
+            v = leaf.value
+            leaf_vals.append(v)
+            if (
+                getattr(v, "nbytes", 0) >= DONATE_MIN_BYTES
+                and _const_owners.get(id(v), 0) == 0
+            ):
+                donate.append(i)
+        else:
+            leaf_vals.append(leaf.value)
+    donate_key = tuple(donate)
+    global _cache_epoch
+    if _cache_epoch != _mesh.mesh_epoch:
+        _compile_cache.clear()
+        _cache_epoch = _mesh.mesh_epoch
+    key = (program.key, donate_key)
+    fn = _compile_cache.get(key)
+    if fn is None:
+        if len(_compile_cache) >= _COMPILE_CACHE_MAX:
+            _compile_cache.pop(next(iter(_compile_cache)))
+        fn = jax.jit(_build_callable(program), donate_argnums=donate_key)
+        _compile_cache[key] = fn
+        stats["compiles"] += 1
+        if common.show_code:
+            import sys
+
+            print(
+                jax.make_jaxpr(_build_callable(program))(*leaf_vals),
+                file=sys.stderr,
+            )
+    stats["flushes"] += 1
+    stats["nodes_flushed"] += len(program.instrs)
+    with warnings.catch_warnings():
+        warnings.filterwarnings("ignore", message=".*[Dd]onat.*")
+        outs = fn(*leaf_vals)
+    del leaf_vals
+    for arr, val in zip(roots, outs[: len(roots)]):
+        arr._set_expr(Const(val))
+    return list(outs[len(roots):])
+
+
+def sync() -> None:
+    """Flush and wait for device completion (the reference's ``ramba.sync``
+    barriers on a remote ``nop``, ramba.py:9843-9849)."""
+    waiters = _pending_arrays()
+    flush()
+    jax.block_until_ready(
+        [a._expr.value for a in waiters if isinstance(a._expr, Const)]
+    )
+
+
+def evaluate(expr: Expr):
+    """Evaluate one expression (flushing all pending work alongside it)."""
+    if isinstance(expr, Const):
+        return expr.value
+    return flush(extra=[expr])[0]

@@ -1,0 +1,21 @@
+"""NumPy protocol interop: the ``__array_function__`` dispatch registry.
+
+Reference: the ``@implements``/HANDLED_FUNCTIONS mechanism
+(/root/reference/ramba/ramba.py:8536-8543) plus the generated module-level
+wrappers (ramba.py:9682-9745) that let ``numpy.sin(ramba_array)`` and xarray
+work through the NumPy dispatch protocol.
+"""
+
+from __future__ import annotations
+
+HANDLED_FUNCTIONS: dict = {}
+
+
+def implements(np_function):
+    """Register an implementation for a NumPy function."""
+
+    def decorator(func):
+        HANDLED_FUNCTIONS[np_function] = func
+        return func
+
+    return decorator

@@ -1,0 +1,90 @@
+"""Boolean-mask arrays.
+
+Reference semantics (/root/reference/docs/index.md:60-68, ramba.py:5908-5911,
+6148-6154, 8476-8478): ``a[a > 0]`` produces an array that *keeps the logical
+shape* and carries a boolean mask; elementwise ops apply under the mask,
+writes are guarded, and reductions consider only selected elements.  The
+reference emits ``if mask: ...`` guard lines into its fused Numba kernels;
+here every masked op is a fused ``where`` select, and masked reductions
+substitute the reduction identity — both stay inside the single jitted flush.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ramba_tpu.core.expr import Node
+from ramba_tpu.core.ndarray import ViewOp, as_exprable, ndarray
+
+
+class _IdentityView(ViewOp):
+    def read(self, base_expr):
+        return base_expr
+
+    def write(self, base_expr, value_expr):
+        return value_expr
+
+
+class MaskedArray(ndarray):
+    """Same logical shape as its parent; only mask-selected elements are
+    meaningful.  In-place ops write through to the parent (guarded)."""
+
+    __slots__ = ("_mask",)
+
+    def __init__(self, parent: ndarray, mask: ndarray):
+        super().__init__(base=parent, view=_IdentityView())
+        self._mask = mask
+
+    # -- guarded elementwise ---------------------------------------------------
+
+    def _map(self, fname, *others, reverse=False):
+        dense = self.read_expr()
+        args = [as_exprable(o) for o in others]
+        operands = [dense] + args
+        if reverse:
+            operands = operands[::-1]
+        val = Node("map", (fname,), operands)
+        guarded = Node("masked_fill", (), [dense, self._mask.read_expr(), val])
+        return MaskedArray(ndarray(guarded), self._mask)
+
+    def _inplace_map(self, fname, other):
+        dense = self.read_expr()
+        val = Node("map", (fname,), [dense, as_exprable(other)])
+        if np.dtype(val.dtype) != self.dtype:
+            val = Node("cast", (str(self.dtype),), [val])
+        self._base.write_expr(
+            Node("masked_fill", (), [dense, self._mask.read_expr(), val])
+        )
+        return self
+
+    # -- masked reductions -----------------------------------------------------
+
+    def _reduce(self, fname, axis=None, keepdims=False, ddof=None):
+        from ramba_tpu.core.ndarray import _norm_axis
+
+        axis = _norm_axis(axis, self.ndim)
+        if fname in ("var", "std"):
+            # two-pass via masked mean
+            m = self._reduce("mean", axis, True)
+            d = (ndarray(self.read_expr()) - m)
+            sq = d * d
+            v = MaskedArray(sq, self._mask)._reduce("mean", axis, keepdims)
+            return v.sqrt() if fname == "std" else v
+        return ndarray(
+            Node(
+                "reduce_where",
+                (fname, axis, bool(keepdims)),
+                [self.read_expr(), self._mask.read_expr()],
+            )
+        )
+
+    def count(self):
+        return self._mask.sum()
+
+    def compressed(self) -> np.ndarray:
+        """Selected elements as a dense 1-D host array (data-dependent shape —
+        must materialize; the reference faces the same constraint and keeps
+        masked arrays logical-shaped for exactly this reason)."""
+        dense = self.asarray()
+        mask = self._mask.asarray()
+        return dense[mask]

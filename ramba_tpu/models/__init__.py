@@ -1,0 +1,1 @@
+"""ramba_tpu.models subpackage."""

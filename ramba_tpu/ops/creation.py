@@ -1,0 +1,247 @@
+"""Array creation API.
+
+Reference: the creation functions at /root/reference/ramba/ramba.py:8546-9117
+(`zeros/ones/empty/full/arange/linspace/eye/fromfunction/fromarray/mgrid/
+meshgrid/...`).  Every creation op is a lazy expression node that generates
+its data *on device, already sharded* (via an XLA iota / broadcast under a
+sharding constraint) and fuses with downstream consumers — the analog of the
+reference's Filler kernels running inside each worker's shard
+(ramba.py:141-150,1947-2071).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ramba_tpu.core import expr as E
+from ramba_tpu.core.expr import Const, Node
+from ramba_tpu.core.ndarray import ndarray, as_exprable, _device_put_default
+from ramba_tpu.parallel import mesh as _mesh
+
+
+def _canon_shape(shape):
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def _spec_tuple(shape):
+    return tuple(_mesh.default_spec(shape))
+
+
+def empty(shape, dtype=float, local_border=0):
+    """`local_border` accepted for API parity with the reference's halo
+    padding (ramba.py:5409 ndarray(..., local_border=)); halos here are
+    carried by the stencil engine (parallel/stencil.py), not the array."""
+    return full(shape, 0, dtype)
+
+
+def zeros(shape, dtype=float, local_border=0):
+    return full(shape, 0, dtype)
+
+
+def ones(shape, dtype=float, local_border=0):
+    return full(shape, 1, dtype)
+
+
+def full(shape, fill_value, dtype=None, local_border=0):
+    shape = _canon_shape(shape)
+    if dtype is None:
+        dtype = np.result_type(fill_value)
+    dtype = np.dtype(jnp.dtype(dtype))
+    return ndarray(
+        Node("full", (shape, str(dtype), _spec_tuple(shape)),
+             [as_exprable(fill_value)])
+    )
+
+
+def _like_shape_dtype(a, dtype):
+    if isinstance(a, ndarray):
+        return a.shape, (dtype or a.dtype)
+    a = np.asarray(a)
+    return a.shape, (dtype or a.dtype)
+
+
+def empty_like(a, dtype=None):
+    return zeros_like(a, dtype)
+
+
+def zeros_like(a, dtype=None):
+    shape, dtype = _like_shape_dtype(a, dtype)
+    return full(shape, 0, dtype)
+
+
+def ones_like(a, dtype=None):
+    shape, dtype = _like_shape_dtype(a, dtype)
+    return full(shape, 1, dtype)
+
+
+def full_like(a, fill_value, dtype=None):
+    shape, dtype = _like_shape_dtype(a, dtype)
+    return full(shape, fill_value, dtype)
+
+
+def arange(start, stop=None, step=None, dtype=None, local_border=0):
+    """Reference: arange_executor emits `res = index[0]+global_start` into the
+    fused kernel (ramba.py:8952-8972); here it is a sharded iota."""
+    if stop is None:
+        start, stop = 0, start
+    if step is None:
+        step = 1
+    n = int(max(0, -(-(stop - start) // step) if step != 0 else 0))
+    if dtype is None:
+        dtype = np.result_type(type(start + stop + step))
+        if all(isinstance(x, (int, np.integer)) for x in (start, stop, step)):
+            dtype = np.dtype(jnp.dtype(int))
+        else:
+            dtype = np.dtype(jnp.dtype(float))
+    dtype = np.dtype(jnp.dtype(dtype))
+    shape = (n,)
+    return ndarray(
+        Node("arange", (n, str(dtype), _spec_tuple(shape)),
+             [E.as_expr(start), E.as_expr(step)])
+    )
+
+
+def linspace(start, stop, num=50, endpoint=True, dtype=None):
+    if dtype is None:
+        dtype = np.dtype(jnp.dtype(float))
+    shape = (int(num),)
+    return ndarray(
+        Node("linspace", (int(num), bool(endpoint), str(np.dtype(dtype)),
+                          _spec_tuple(shape)),
+             [E.as_expr(start), E.as_expr(stop)])
+    )
+
+
+def eye(N, M=None, k=0, dtype=float):
+    M = N if M is None else M
+    shape = (int(N), int(M))
+    return ndarray(
+        Node("eye", (int(N), int(M), int(k), str(np.dtype(jnp.dtype(dtype))),
+                     _spec_tuple(shape)), [])
+    )
+
+
+def identity(n, dtype=float):
+    return eye(n, dtype=dtype)
+
+
+def fromfunction(function, shape, dtype=float, **kwargs):
+    """Reference: init_fromfunction / Filler.PER_ELEMENT
+    (ramba.py:8684-8712,1535-1595).  ``function`` must be jax-traceable; it
+    receives index grids and runs fused inside the flush."""
+    shape = _canon_shape(shape)
+    dt = str(np.dtype(jnp.dtype(dtype))) if dtype is not None else None
+    return ndarray(
+        Node("fromfunction", (shape, dt, _spec_tuple(shape), function, True), [])
+    )
+
+
+def init_array(shape, filler, dtype=float):
+    """Reference API: ramba.init_array with a per-element filler
+    (docs/index.md; ramba.py:8684-8712)."""
+    return fromfunction(filler, shape, dtype=dtype)
+
+
+def fromarray(arr, dtype=None, distribution=None):
+    """Distribute a host array (reference: fromarray, ramba.py:8727-8760)."""
+    a = np.asarray(arr, dtype=dtype)
+    return ndarray(Const(_device_put_default(a)))
+
+
+def asarray(a, dtype=None):
+    if isinstance(a, ndarray):
+        return a.astype(dtype) if dtype is not None and np.dtype(dtype) != a.dtype else a
+    return fromarray(a, dtype=dtype)
+
+
+def array(a, dtype=None, copy=True):
+    if isinstance(a, ndarray):
+        out = a.copy() if copy else a
+        return out.astype(dtype) if dtype is not None else out
+    return fromarray(a, dtype=dtype)
+
+
+def copy(a):
+    return a.copy() if isinstance(a, ndarray) else fromarray(np.copy(a))
+
+
+def tri(N, M=None, k=0, dtype=float):
+    M = N if M is None else M
+
+    def f(i, j):
+        return (j - i) <= k
+
+    out = fromfunction(f, (int(N), int(M)), dtype=bool)
+    return out.astype(dtype)
+
+
+def meshgrid(*xi, indexing="xy"):
+    """Reference: RemoteState.meshgrid (ramba.py:3821-3856)."""
+    arrs = [asarray(x).reshape(-1) for x in xi]
+    nd = len(arrs)
+    lens = [a.size for a in arrs]
+    if indexing == "xy" and nd >= 2:
+        shape = tuple([lens[1], lens[0]] + lens[2:])
+
+        def axis_of(d):
+            return 1 if d == 0 else (0 if d == 1 else d)
+    else:
+        shape = tuple(lens)
+
+        def axis_of(d):
+            return d
+    outs = []
+    for d in range(nd):
+        vs = [1] * nd
+        vs[axis_of(d)] = lens[d]
+        outs.append(arrs[d].reshape(tuple(vs)).broadcast_to(shape).copy())
+    return outs
+
+
+class _MGrid:
+    """np.mgrid equivalent (reference: mgrid, ramba.py:8952-9047 area)."""
+
+    def __getitem__(self, key):
+        if not isinstance(key, tuple):
+            key = (key,)
+        shape = []
+        starts = []
+        for s in key:
+            start = s.start or 0
+            stop = s.stop
+            step = s.step or 1
+            shape.append(int(max(0, -(-(stop - start) // step))))
+            starts.append((start, step))
+        shape = tuple(shape)
+        outs = []
+        for d in range(len(shape)):
+            start, step = starts[d]
+
+            def f(*idx, _d=d, _s=start, _st=step):
+                return idx[_d] * _st + _s
+
+            outs.append(fromfunction(f, shape, dtype=int))
+        if len(outs) == 1:
+            return outs[0]
+        from ramba_tpu.ops.manipulation import stack
+
+        return stack(outs)
+
+
+mgrid = _MGrid()
+
+
+def indices(dimensions, dtype=int):
+    from ramba_tpu.ops.manipulation import stack
+
+    shape = _canon_shape(dimensions)
+    outs = []
+    for d in range(len(shape)):
+        def f(*idx, _d=d):
+            return idx[_d]
+
+        outs.append(fromfunction(f, shape, dtype=dtype))
+    return stack(outs)

@@ -1,0 +1,92 @@
+"""Linear algebra.
+
+Reference: the hand-rolled distributed GEMM engine — ndarray.dot/matmul
+(/root/reference/ramba/ramba.py:6933-6989), matmul_2D/matmul_internal with its
+three communication strategies (:6993-7618) and the worker-side block
+exchange + k-window accumulation (RemoteState.matmul, :2493-3051).
+
+On TPU none of that machinery survives: a sharded jnp.matmul hits the MXU and
+GSPMD chooses the collective schedule (all-gather vs reduce-scatter) over
+ICI.  N-D matmul/dot decomposition rules match the reference's
+(broadcast+multiply+sum decomposition at ramba.py:6953-6989).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ramba_tpu.core.expr import Node
+from ramba_tpu.core.ndarray import ndarray, as_exprable
+from ramba_tpu.ops.creation import asarray
+
+# Default matmul precision: None lets XLA pick (bf16 passes on the MXU for
+# f32 inputs); set to "highest" for strict f32 accumulation parity.
+_PRECISION = None
+
+
+def set_matmul_precision(p):
+    global _PRECISION
+    _PRECISION = p
+
+
+def matmul(a, b):
+    return ndarray(
+        Node("matmul", (_PRECISION,),
+             [as_exprable(asarray(a)), as_exprable(asarray(b))])
+    )
+
+
+def dot(a, b):
+    return ndarray(
+        Node("dot", (_PRECISION,),
+             [as_exprable(asarray(a)), as_exprable(asarray(b))])
+    )
+
+
+def vdot(a, b):
+    a = asarray(a).ravel()
+    b = asarray(b).ravel()
+    return (a * b).sum()
+
+
+def inner(a, b):
+    a = asarray(a)
+    b = asarray(b)
+    if a.ndim == 0 or b.ndim == 0:
+        return a * b
+    return tensordot(a, b, axes=(a.ndim - 1, b.ndim - 1))
+
+
+def outer(a, b):
+    return ndarray(
+        Node("outer", (),
+             [as_exprable(asarray(a).ravel()), as_exprable(asarray(b).ravel())])
+    )
+
+
+def tensordot(a, b, axes=2):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(
+            tuple(x) if isinstance(x, (list, tuple)) else (x,) for x in axes
+        )
+    return ndarray(
+        Node("tensordot", (axes, _PRECISION),
+             [as_exprable(asarray(a)), as_exprable(asarray(b))])
+    )
+
+
+def einsum(subscripts, *operands):
+    return ndarray(
+        Node("einsum", (subscripts, _PRECISION),
+             [as_exprable(asarray(o)) for o in operands])
+    )
+
+
+def trace(a, offset=0):
+    a = asarray(a)
+    n, m = a.shape[-2:]
+    from ramba_tpu.ops.manipulation import diag
+
+    if a.ndim == 2:
+        return diag(a, offset).sum()
+    raise NotImplementedError("trace only for 2-D arrays")

@@ -1,0 +1,133 @@
+"""Module-level reductions.
+
+Reference: `array_simple_reductions` + reduction executors
+(/root/reference/ramba/ramba.py:5789-5939,7961-7993).  The reference runs a
+fused per-worker partial reduction followed by an explicit cross-worker
+finish (internal_reduction2/2b); here the lazy reduce node lowers to an XLA
+reduce whose cross-shard combine is a hardware all-reduce over ICI.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ramba_tpu.core.expr import Node
+from ramba_tpu.core.ndarray import ndarray, as_exprable
+from ramba_tpu.ops.creation import asarray
+
+
+def _red(name, a, axis=None, keepdims=False, dtype=None, out=None, ddof=None):
+    a = asarray(a)
+    r = a._reduce(name, axis=axis, keepdims=keepdims, ddof=ddof)
+    if dtype is not None:
+        r = r.astype(dtype)
+    if out is not None:
+        out.write_expr(r.read_expr())
+        return out
+    return r
+
+
+def sum(a, axis=None, keepdims=False, dtype=None, out=None):  # noqa: A001
+    return _red("sum", a, axis, keepdims, dtype, out)
+
+
+def prod(a, axis=None, keepdims=False, dtype=None, out=None):
+    return _red("prod", a, axis, keepdims, dtype, out)
+
+
+def min(a, axis=None, keepdims=False, out=None):  # noqa: A001
+    return _red("min", a, axis, keepdims, None, out)
+
+
+def max(a, axis=None, keepdims=False, out=None):  # noqa: A001
+    return _red("max", a, axis, keepdims, None, out)
+
+
+amin = min
+amax = max
+
+
+def mean(a, axis=None, keepdims=False, dtype=None, out=None):
+    return _red("mean", a, axis, keepdims, dtype, out)
+
+
+def var(a, axis=None, keepdims=False, ddof=0):
+    return _red("var", a, axis, keepdims, ddof=ddof)
+
+
+def std(a, axis=None, keepdims=False, ddof=0):
+    return _red("std", a, axis, keepdims, ddof=ddof)
+
+
+def any(a, axis=None, keepdims=False):  # noqa: A001
+    return _red("any", a, axis, keepdims)
+
+
+def all(a, axis=None, keepdims=False):  # noqa: A001
+    return _red("all", a, axis, keepdims)
+
+
+def median(a, axis=None, keepdims=False):
+    return _red("median", a, axis, keepdims)
+
+
+def ptp(a, axis=None, keepdims=False):
+    return _red("ptp", a, axis, keepdims)
+
+
+def argmin(a, axis=None):
+    return _red("argmin", a, axis)
+
+
+def argmax(a, axis=None):
+    return _red("argmax", a, axis)
+
+
+def nansum(a, axis=None, keepdims=False):
+    return _red("nansum", a, axis, keepdims)
+
+
+def nanprod(a, axis=None, keepdims=False):
+    return _red("nanprod", a, axis, keepdims)
+
+
+def nanmin(a, axis=None, keepdims=False):
+    return _red("nanmin", a, axis, keepdims)
+
+
+def nanmax(a, axis=None, keepdims=False):
+    return _red("nanmax", a, axis, keepdims)
+
+
+def nanmean(a, axis=None, keepdims=False):
+    return _red("nanmean", a, axis, keepdims)
+
+
+def nanvar(a, axis=None, keepdims=False, ddof=0):
+    return _red("nanvar", a, axis, keepdims, ddof=ddof)
+
+
+def nanstd(a, axis=None, keepdims=False, ddof=0):
+    return _red("nanstd", a, axis, keepdims, ddof=ddof)
+
+
+def count_nonzero(a, axis=None, keepdims=False):
+    return _red("count_nonzero", a, axis, keepdims)
+
+
+def cumsum(a, axis=None):
+    """Reference: scumulative carry-chain (ramba.py:3378-3437,10057-10115);
+    XLA lowers this to a parallel scan + ICI carry exchange."""
+    return asarray(a).cumsum(axis)
+
+
+def cumprod(a, axis=None):
+    return asarray(a).cumprod(axis)
+
+
+def average(a, axis=None, weights=None):
+    a = asarray(a)
+    if weights is None:
+        return a.mean(axis)
+    w = asarray(weights)
+    return sum(a * w, axis=axis) / sum(w, axis=axis)

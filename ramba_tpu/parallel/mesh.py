@@ -1,0 +1,184 @@
+"""Device mesh + partition planning.
+
+TPU-native replacement for the reference's shard/distribution layer:
+
+* the partition scheduler `compute_regular_schedule` that factorizes the worker
+  count into per-dimension splits minimizing communication surface
+  (/root/reference/ramba/common.py:287-680), and
+* the per-worker shardview metadata (/root/reference/ramba/shardview_array.py).
+
+Here the mesh is a `jax.sharding.Mesh` and a "distribution" is a
+`jax.sharding.NamedSharding`; XLA GSPMD owns memory layout and inserts the
+collectives the reference implements by hand over ZMQ/MPI
+(/root/reference/ramba/ramba_queue_zmq.py, ramba_queue_mpi.py).  The
+surface-minimizing schedule solver is retained for the manual shard_map
+paths (stencil halo planning), where cut surface still determines halo
+traffic volume.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from functools import lru_cache
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ramba_tpu import common
+
+_mesh: Optional[Mesh] = None
+# Bumped every time the mesh changes so the fuser can invalidate compiled code
+# that baked in sharding constraints against the old mesh.
+mesh_epoch: int = 0
+
+
+def _make_default_mesh() -> Mesh:
+    devices = jax.devices()
+    n = len(devices)
+    if common.num_workers_env is not None:
+        n = min(n, int(common.num_workers_env))
+        devices = devices[:n]
+    ndim = max(1, min(common.mesh_ndim, 3))
+    factors = balanced_factors(n, ndim)
+    factors = tuple(f for f in factors if f > 1) or (1,)
+    names = tuple(f"d{i}" for i in range(len(factors)))
+    dev_array = np.array(devices).reshape(factors)
+    return Mesh(dev_array, axis_names=names)
+
+
+def get_mesh() -> Mesh:
+    global _mesh
+    if _mesh is None:
+        set_mesh(_make_default_mesh())
+    return _mesh
+
+
+def set_mesh(mesh: Mesh) -> None:
+    """Install a global device mesh (user-facing; like RAMBA_WORKERS env)."""
+    global _mesh, mesh_epoch
+    _mesh = mesh
+    mesh_epoch += 1
+
+
+def num_workers() -> int:
+    return get_mesh().devices.size
+
+
+@lru_cache(maxsize=None)
+def prime_factors(n: int) -> tuple:
+    """Prime factorization (reference: gen_prime_factors,
+    /root/reference/ramba/common.py:300-318)."""
+    out = []
+    d = 2
+    while d * d <= n:
+        while n % d == 0:
+            out.append(d)
+            n //= d
+        d += 1
+    if n > 1:
+        out.append(n)
+    return tuple(out)
+
+
+@lru_cache(maxsize=None)
+def balanced_factors(n: int, k: int) -> tuple:
+    """Split n into k factors as balanced as possible (largest first)."""
+    factors = [1] * k
+    for p in sorted(prime_factors(n), reverse=True):
+        factors[int(np.argmin(factors))] *= p
+    return tuple(sorted(factors, reverse=True))
+
+
+@lru_cache(maxsize=4096)
+def compute_regular_schedule(shape: tuple, n: int) -> tuple:
+    """Choose per-dimension splits of ``n`` workers over ``shape`` minimizing
+    the inter-shard surface area.
+
+    TPU-first re-design of the reference partition scheduler
+    (/root/reference/ramba/common.py:287-680, modes ratio/surface/nodesurface):
+    rather than materializing per-worker index ranges, the output here is just
+    the split count per dimension; the actual layout is delegated to
+    NamedSharding.  Splits never exceed the dimension size.
+    """
+    ndim = len(shape)
+    if ndim == 0 or n <= 1:
+        return (1,) * ndim
+    best = None
+    best_cost = math.inf
+    primes = prime_factors(n)
+    # Enumerate assignments of prime factors to dimensions (n is small: the
+    # worker count, typically <= a few thousand; primes are few).
+    for assignment in itertools.product(range(ndim), repeat=len(primes)):
+        splits = [1] * ndim
+        for p, d in zip(primes, assignment):
+            splits[d] *= p
+        if any(s > max(1, shape[d]) for d, s in enumerate(splits)):
+            continue
+        # Cost = total cut surface: for each dim, (splits-1) cuts, each of area
+        # prod(shape)/shape[d].
+        total = math.prod(shape) if shape else 1
+        cost = sum(
+            (s - 1) * (total / shape[d]) for d, s in enumerate(splits) if shape[d] > 0
+        )
+        if cost < best_cost:
+            best_cost = cost
+            best = tuple(splits)
+    return best if best is not None else (1,) * ndim
+
+
+def default_spec(shape: Sequence[int], mesh: Optional[Mesh] = None) -> P:
+    """Pick a PartitionSpec for a new array of ``shape``.
+
+    Small arrays are replicated (reference: do_not_distribute,
+    /root/reference/ramba/common.py:217-218).  Otherwise mesh axes are greedily
+    assigned to the largest array dims that they divide into usefully.
+    """
+    mesh = mesh or get_mesh()
+    shape = tuple(int(s) for s in shape)
+    if len(shape) == 0 or math.prod(shape) < common.dist_threshold:
+        return P()
+    axes = sorted(mesh.shape.items(), key=lambda kv: -kv[1])  # (name, size)
+    dims_by_size = sorted(range(len(shape)), key=lambda d: -shape[d])
+    assignment: dict[int, list] = {}
+    used_dims = set()
+    for name, size in axes:
+        placed = False
+        for d in dims_by_size:
+            if d in used_dims:
+                continue
+            if shape[d] >= size:
+                assignment[d] = [name]
+                used_dims.add(d)
+                placed = True
+                break
+        if not placed:
+            # Stack this axis onto the largest already-assigned dim if the dim
+            # can absorb it; otherwise leave it unused (replicate over it).
+            for d in dims_by_size:
+                if d in used_dims and shape[d] >= size * math.prod(
+                    mesh.shape[a] for a in assignment[d]
+                ):
+                    assignment[d].append(name)
+                    placed = True
+                    break
+    entries = []
+    for d in range(len(shape)):
+        if d in assignment:
+            names = assignment[d]
+            entries.append(names[0] if len(names) == 1 else tuple(names))
+        else:
+            entries.append(None)
+    while entries and entries[-1] is None:
+        entries.pop()
+    return P(*entries)
+
+
+def default_sharding(shape: Sequence[int]) -> NamedSharding:
+    return NamedSharding(get_mesh(), default_spec(shape))
+
+
+def replicated_sharding() -> NamedSharding:
+    return NamedSharding(get_mesh(), P())

@@ -1,0 +1,119 @@
+"""Distributed random number generation.
+
+Reference: /root/reference/ramba/random/random.py — fillers that run
+``np.random`` inside each worker shard after seeding ``seed + worker_num``
+(ramba.py:3824-3825).  That scheme makes results depend on the worker count;
+here a single `jax.random` threefry stream generates the whole logical array
+(sharded, on device), so results are *device-count invariant* — a deliberate
+improvement enabled by counter-based RNG.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ramba_tpu.core.expr import Const, Node
+from ramba_tpu.core.ndarray import ndarray
+from ramba_tpu.parallel import mesh as _mesh
+
+_key = jax.random.key(0)
+
+
+def seed(s: int) -> None:
+    """Reference: ramba.random.seed → RemoteState.seed (ramba.py:3824-3825)."""
+    global _key
+    _key = jax.random.key(int(s))
+
+
+def _next_key():
+    global _key
+    _key, sub = jax.random.split(_key)
+    return sub
+
+
+def _canon_shape(size):
+    if size is None:
+        return ()
+    if isinstance(size, (int, np.integer)):
+        return (int(size),)
+    return tuple(int(s) for s in size)
+
+
+def _rand(kind, shape, dtype, params=()):
+    shape = _canon_shape(shape)
+    spec = tuple(_mesh.default_spec(shape))
+    return ndarray(
+        Node("random", (kind, shape, str(np.dtype(dtype)), spec),
+             [Const(_next_key())] + [Const(jnp.asarray(p)) for p in params])
+    )
+
+
+def random(size=None):
+    return _rand("uniform", size, jnp.zeros(0).dtype)
+
+
+random_sample = random
+sample = random
+
+
+def rand(*shape):
+    return random(shape)
+
+
+def randn(*shape):
+    return normal(size=shape)
+
+
+def normal(loc=0.0, scale=1.0, size=None):
+    out = _rand("normal", size, jnp.zeros(0).dtype)
+    if scale != 1.0:
+        out = out * scale
+    if loc != 0.0:
+        out = out + loc
+    return out
+
+
+def uniform(low=0.0, high=1.0, size=None):
+    return _rand("uniform_range", size, jnp.zeros(0).dtype, (low, high))
+
+
+def randint(low, high=None, size=None, dtype=int):
+    if high is None:
+        low, high = 0, low
+    return _rand("randint", size, jnp.dtype(dtype), (low, high))
+
+
+class RandomState:
+    """Reference: RandomState passthrough (ramba/random/random.py)."""
+
+    def __init__(self, s=None):
+        self._key = jax.random.key(0 if s is None else int(s))
+
+    def _next(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def random(self, size=None):
+        k = self._next()
+        shape = _canon_shape(size)
+        spec = tuple(_mesh.default_spec(shape))
+        return ndarray(
+            Node("random", ("uniform", shape, str(np.dtype(jnp.zeros(0).dtype)),
+                            spec), [Const(k)])
+        )
+
+    def normal(self, loc=0.0, scale=1.0, size=None):
+        k = self._next()
+        shape = _canon_shape(size)
+        spec = tuple(_mesh.default_spec(shape))
+        out = ndarray(
+            Node("random", ("normal", shape, str(np.dtype(jnp.zeros(0).dtype)),
+                            spec), [Const(k)])
+        )
+        return out * scale + loc
+
+
+def default_rng(s=None):
+    return RandomState(s)

@@ -1,0 +1,23 @@
+"""Test harness configuration.
+
+Mirrors the reference CI strategy (/root/reference/.github/workflows/
+python-package.yml:40-46): the reference runs its suite on a fake 2-worker
+cluster (Ray local + mpiexec -n 2); here we run on an 8-device virtual CPU
+mesh via --xla_force_host_platform_device_count so every sharding/collective
+path executes without TPU hardware, and enable x64 so numerics match NumPy
+exactly for differential tests.
+
+Must run before any jax backend initialization; the axon TPU site-hook forces
+jax_platforms, so we override through jax.config rather than the env var.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
