@@ -1,0 +1,75 @@
+"""Headline benchmark: 1e9-element fused elementwise chain + reduction.
+
+Mirrors the reference's flagship example (/root/reference/README.md:16-65,
+sample/test-ramba.py):
+
+    A = arange(1e9) / 1000;  B = sin(A);  C = cos(A);  D = B*B + C**2
+
+plus a global sum over D (BASELINE config 2).  Reference numbers on a
+36-core Xeon node: NumPy 47.56 s, Ramba 3.86 s.  ``vs_baseline`` reported
+here is the speedup over the NumPy wall-clock (so the reference system
+scores ~12.3 on its own hardware).
+
+Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main():
+    import jax
+
+    import ramba_tpu as rt
+
+    platform = jax.devices()[0].platform
+    n = 1_000_000_000
+    if platform == "cpu":  # debug/dry-run environments
+        n = 10_000_000
+
+    def run_chain():
+        t0 = time.perf_counter()
+        A = rt.arange(n) / 1000.0
+        B = rt.sin(A)
+        C = rt.cos(A)
+        D = B * B + C ** 2
+        s = rt.sum(D)
+        rt.sync()
+        sv = float(s)
+        return time.perf_counter() - t0, sv, D.dtype.itemsize
+
+    # Cold run includes compile (the reference's 3.86 s includes ~1 s of
+    # Numba JIT, README.md:57-65); then steady-state best-of-3.
+    cold, _, itemsize = run_chain()
+    walls = []
+    for _ in range(3):
+        w, sval, itemsize = run_chain()
+        walls.append(w)
+    wall = min(walls)
+
+    # Materialized roots: A, B, C, D (4·n·itemsize written) + reduce read.
+    gbytes = 4 * n * itemsize / 1e9
+    baseline_numpy_s = 47.56  # /root/reference/README.md:31-36
+    scale = n / 1_000_000_000
+    print(
+        json.dumps(
+            {
+                "metric": "1e9-elem fused elementwise+reduce wall-clock",
+                "value": round(wall, 4),
+                "unit": "s",
+                "vs_baseline": round(baseline_numpy_s * scale / wall, 2),
+                "cold_s": round(cold, 2),
+                "hbm_gb_per_s": round(gbytes / wall, 1),
+                "n": n,
+                "platform": platform,
+                "checksum": sval,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

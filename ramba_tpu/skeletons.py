@@ -1,0 +1,591 @@
+"""Algorithmic skeletons: smap / sreduce / sstencil / scumulative / spmd.
+
+Reference: /root/reference/docs/index.md:83-267 and the driver/worker pairs at
+ramba.py:9863-10180 (smap_internal, sreduce_internal, sstencil, scumulative,
+spmd) with worker methods at ramba.py:2203-2491,3315-3491.
+
+TPU-native design:
+
+* ``smap``/``sreduce`` — the reference string-generates per-element Numba
+  kernels (get_smap_fill, ramba.py:1600-1694).  Here the user function is
+  jax-traceable and vectorized into the lazy graph, so it fuses with
+  surrounding ops in the same flush.
+* ``sstencil`` — the reference pads shards, exchanges halos point-to-point
+  (LocalNdarray.getborder, ramba.py:1260-1322) and compiles a per-worker
+  numba.stencil with an asymmetric neighborhood (ramba.py:3339-3358).  Here
+  relative-offset accesses are discovered by probing the kernel and lowered
+  to shifted-slice arithmetic; XLA GSPMD turns the shifted reads into halo
+  collective-permutes over ICI automatically.
+* ``scumulative`` — the reference runs a local scan then a sequential
+  worker-to-worker carry chain (ramba.py:3378-3437).  Here blocks scan in
+  parallel (lax.scan under vmap) and the carry fix-up is unrolled over
+  blocks inside the same compiled program.
+* ``spmd`` — the reference drops to raw per-worker execution
+  (ramba.py:3477-3491).  Here it is a ``shard_map`` over the mesh; local
+  shards arrive as jax arrays wrapped in a LocalView that supports
+  ``get_local()`` (read) and ``set_local()`` (functional write-back, the
+  TPU-native replacement for in-place shard mutation).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ramba_tpu.core.expr import Const, Node, defop
+from ramba_tpu.core.fuser import sync as _sync
+from ramba_tpu.core.ndarray import ndarray
+from ramba_tpu.ops.creation import asarray
+from ramba_tpu.parallel import mesh as _mesh
+
+# ---------------------------------------------------------------------------
+# smap / smap_index
+# ---------------------------------------------------------------------------
+
+
+class _KVal:
+    """Kernel-value proxy: lets user kernels written against *NumPy* (the
+    reference compiles them with Numba, so ``np.maximum(x, y)`` is idiomatic
+    there) trace under jax.  NumPy ufuncs dispatch here via __array_ufunc__
+    and are rerouted to jax.numpy; arithmetic operators chain through."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        if method != "__call__" or kwargs:
+            return NotImplemented
+        name = {"divide": "true_divide", "absolute": "abs"}.get(
+            ufunc.__name__, ufunc.__name__
+        )
+        fn = getattr(jnp, name, None)
+        if fn is None:
+            return NotImplemented
+        return _KVal(fn(*[_unwrap(i) for i in inputs]))
+
+    def __getitem__(self, idx):
+        return _KVal(self.v[idx])
+
+    @property
+    def shape(self):
+        return jnp.shape(self.v)
+
+    @property
+    def dtype(self):
+        return jnp.result_type(self.v)
+
+
+def _unwrap(x):
+    return x.v if isinstance(x, _KVal) else x
+
+
+def _install_kval_ops():
+    binops = {
+        "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+        "truediv": jnp.true_divide, "floordiv": jnp.floor_divide,
+        "mod": jnp.mod, "pow": jnp.power, "and": jnp.bitwise_and,
+        "or": jnp.bitwise_or, "xor": jnp.bitwise_xor,
+        "lt": jnp.less, "le": jnp.less_equal, "gt": jnp.greater,
+        "ge": jnp.greater_equal, "eq": jnp.equal, "ne": jnp.not_equal,
+    }
+    for name, fn in binops.items():
+        def fwd(self, other, _f=fn):
+            return _KVal(_f(self.v, _unwrap(other)))
+
+        def rev(self, other, _f=fn):
+            return _KVal(_f(_unwrap(other), self.v))
+
+        setattr(_KVal, f"__{name}__", fwd)
+        if name not in ("lt", "le", "gt", "ge", "eq", "ne"):
+            setattr(_KVal, f"__r{name}__", rev)
+    for name, fn in {"neg": jnp.negative, "pos": jnp.positive,
+                     "abs": jnp.abs, "invert": jnp.invert}.items():
+        def un(self, _f=fn):
+            return _KVal(_f(self.v))
+
+        setattr(_KVal, f"__{name}__", un)
+
+
+_install_kval_ops()
+
+
+def _call_kernel(func, *vals):
+    """Call a user kernel on traced values; if it reaches for NumPy (which
+    cannot consume tracers), retry with _KVal proxies."""
+    try:
+        return _unwrap(func(*vals))
+    except (jax.errors.TracerArrayConversionError, TypeError):
+        wrapped = [
+            _KVal(v) if isinstance(v, (jax.Array, jnp.ndarray)) or hasattr(v, "aval")
+            else v
+            for v in vals
+        ]
+        return _unwrap(func(*wrapped))
+
+
+class _Lit:
+    """Identity-hashed wrapper so unhashable literals (e.g. whole numpy
+    arrays passed through to the kernel) can live in a node's static tuple."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __hash__(self):
+        return id(self)
+
+    def __eq__(self, other):
+        return self is other
+
+
+def _split_operands(args):
+    """Partition skeleton args into element-wise array operands vs
+    pass-through literals (the reference passes non-distributed args whole,
+    docs/index.md:108-113)."""
+    slots = []  # ("arr", operand_index) | ("lit", _Lit)
+    operands = []
+    for a in args:
+        if isinstance(a, ndarray):
+            slots.append(("arr", len(operands)))
+            operands.append(a.read_expr())
+        else:
+            slots.append(("lit", _Lit(a)))
+    return slots, operands
+
+
+@defop("smap")
+def _op_smap(static, *arrs):
+    func, slots, with_index, ndim = static
+
+    def elem(*vals):
+        it = iter(vals)
+        idx_vals = []
+        if with_index:
+            idx_vals = [next(it) for _ in range(ndim)]
+        call_args = []
+        for kind, payload in slots:
+            if kind == "arr":
+                call_args.append(next(it))
+            else:
+                call_args.append(payload.v)
+        if with_index:
+            return _call_kernel(func, tuple(idx_vals), *call_args)
+        return _call_kernel(func, *call_args)
+
+    vec = jnp.vectorize(elem)
+    if with_index:
+        shape = arrs[0].shape
+        iotas = [jax.lax.broadcasted_iota(jnp.int32, shape, d)
+                 for d in range(len(shape))]
+        return vec(*iotas, *arrs)
+    return vec(*arrs)
+
+
+def smap(func: Callable, arr, *args):
+    """Reference: ramba.smap (docs/index.md:92-137, ramba.py:9863-9931)."""
+    arr = asarray(arr)
+    slots, operands = _split_operands((arr,) + args)
+    return ndarray(Node("smap", (func, tuple(slots), False, arr.ndim), operands))
+
+
+def smap_index(func: Callable, arr, *args):
+    arr = asarray(arr)
+    slots, operands = _split_operands((arr,) + args)
+    return ndarray(Node("smap", (func, tuple(slots), True, arr.ndim), operands))
+
+
+# ---------------------------------------------------------------------------
+# sreduce / sreduce_index
+# ---------------------------------------------------------------------------
+
+
+class SreduceReducer:
+    """Worker-local vs cross-worker reducer split (reference:
+    SreduceReducer, ramba.py:9934-9939)."""
+
+    def __init__(self, worker_reducer, driver_reducer):
+        self.worker_reducer = worker_reducer
+        self.driver_reducer = driver_reducer
+
+
+@defop("sreduce")
+def _op_sreduce(static, mapped):
+    local_fn, global_fn, identity, use_shard_split = static
+    if not use_shard_split:
+        flat = mapped.reshape(-1)
+        return jax.lax.reduce(flat, jnp.asarray(identity, flat.dtype),
+                              lambda a, b: _call_kernel(local_fn, a, b), (0,))
+
+    # SreduceReducer path: per-shard reduce with the worker reducer inside
+    # shard_map, then combine the per-shard partials with the driver reducer
+    # (the reference's log2 tree over comm queues, ramba.py:2296-2331).
+    mesh = _mesh.get_mesh()
+    axes = tuple(mesh.axis_names)
+    flat = mapped.reshape(-1)
+    n = int(np.prod([mesh.shape[a] for a in axes]))
+    pad = (-flat.shape[0]) % n
+    if pad:
+        flat = jnp.concatenate(
+            [flat, jnp.full((pad,), identity, flat.dtype)], 0
+        )
+
+    def local(block):
+        r = jax.lax.reduce(block, jnp.asarray(identity, block.dtype),
+                           lambda a, b: _call_kernel(local_fn, a, b), (0,))
+        return r[None]
+
+    partials = jax.shard_map(
+        local, mesh=mesh, in_specs=P(axes), out_specs=P(axes),
+        check_vma=False,
+    )(flat)
+    return jax.lax.reduce(partials, jnp.asarray(identity, partials.dtype),
+                          lambda a, b: _call_kernel(global_fn, a, b), (0,))
+
+
+def _sreduce_impl(func, reducer, identity, arr, args, with_index):
+    arr = asarray(arr)
+    slots, operands = _split_operands((arr,) + args)
+    mapped = ndarray(
+        Node("smap", (func, tuple(slots), with_index, arr.ndim), operands)
+    )
+    if isinstance(reducer, SreduceReducer):
+        static = (reducer.worker_reducer, reducer.driver_reducer, identity, True)
+    else:
+        static = (reducer, reducer, identity, False)
+    return ndarray(Node("sreduce", static, [mapped.read_expr()]))
+
+
+def sreduce(func, reducer, identity, arr, *args):
+    """Reference: ramba.sreduce (docs/index.md:141-186, ramba.py:9942-9984)."""
+    return _sreduce_impl(func, reducer, identity, arr, args, False)
+
+
+def sreduce_index(func, reducer, identity, arr, *args):
+    return _sreduce_impl(func, reducer, identity, arr, args, True)
+
+
+# ---------------------------------------------------------------------------
+# stencil decorator + sstencil
+# ---------------------------------------------------------------------------
+
+
+class _ProbeValue:
+    """Arithmetic-absorbing value used while probing a stencil kernel for
+    its relative-offset access pattern (the reference probes with a local
+    numba.stencil run, ramba.py:9989-10000)."""
+
+    def _op(self, *_):
+        return _ProbeValue()
+
+    for _name in ["__add__", "__radd__", "__sub__", "__rsub__", "__mul__",
+                  "__rmul__", "__truediv__", "__rtruediv__", "__pow__",
+                  "__rpow__", "__neg__", "__floordiv__", "__rfloordiv__",
+                  "__mod__", "__rmod__", "__abs__"]:
+        locals()[_name] = _op
+    del _name
+
+
+class _ProbeProxy:
+    def __init__(self):
+        self.offsets = []
+
+    def __getitem__(self, off):
+        if not isinstance(off, tuple):
+            off = (off,)
+        self.offsets.append(tuple(int(o) for o in off))
+        return _ProbeValue()
+
+
+class _ShiftProxy:
+    """Relative indexing over the interior window: ``a[di, dj]`` becomes a
+    shifted static slice; XLA fuses all shifted reads into one stencil
+    kernel and GSPMD inserts the halo exchange the reference does by hand
+    (compute_from_border tables, shardview_array.py:1069-1136)."""
+
+    def __init__(self, arr, lo, interior, wrap=False):
+        self.arr = arr
+        self.lo = lo
+        self.interior = interior
+        self.wrap = wrap
+
+    def __getitem__(self, off):
+        if not isinstance(off, tuple):
+            off = (off,)
+        idx = tuple(
+            slice(o - l, o - l + n)
+            for o, l, n in zip(off, self.lo, self.interior)
+        )
+        piece = self.arr[idx]
+        return _KVal(piece) if self.wrap else piece
+
+
+class StencilKernel:
+    """Result of the ``ramba.stencil`` decorator (reference: StencilMetadata,
+    ramba.py:441-541).  Callable directly on host arrays, or distributed via
+    ``sstencil``."""
+
+    def __init__(self, func):
+        self.func = func
+        self._probe_cache = None
+        self._probe_key = None
+
+    def neighborhood(self, slots):
+        """Probe the kernel: array slots get offset-recording proxies,
+        literal slots get their real values (additional sstencil args 'may be
+        of any type', docs/index.md)."""
+        cache_key = tuple(kind for kind, _ in slots)
+        if self._probe_cache is None or self._probe_key != cache_key:
+            probes = []
+            call_args = []
+            for kind, payload in slots:
+                if kind == "arr":
+                    p = _ProbeProxy()
+                    probes.append(p)
+                    call_args.append(p)
+                else:
+                    call_args.append(payload.v)
+            try:
+                self.func(*call_args)
+            except Exception as e:  # kernel must be offset-indexing only
+                raise ValueError(
+                    f"could not probe stencil kernel {self.func}: {e}"
+                ) from e
+            all_offs = [o for p in probes for o in p.offsets]
+            nd = len(all_offs[0]) if all_offs else 1
+            lo = tuple(min(0, *(o[d] for o in all_offs)) if all_offs else 0
+                       for d in range(nd))
+            hi = tuple(max(0, *(o[d] for o in all_offs)) if all_offs else 0
+                       for d in range(nd))
+            self._probe_cache = (lo, hi)
+            self._probe_key = cache_key
+        return self._probe_cache
+
+    def __call__(self, *args):
+        # direct host call (reference: "using a Ramba stencil directly only
+        # NumPy arrays may be used", docs/index.md)
+        slots = []
+        operands = []
+        for a in args:
+            if isinstance(a, (np.ndarray, list, jax.Array)):
+                slots.append(("arr", len(operands)))
+                operands.append(jnp.asarray(a))
+            else:
+                slots.append(("lit", _Lit(a)))
+        lo, hi = self.neighborhood(tuple(slots))
+        return np.asarray(
+            _eval_stencil((self.func, lo, hi, tuple(slots)), *operands)
+        )
+
+
+def stencil(func=None, **kwargs):
+    """Decorator (reference: ramba.stencil, ramba.py:508-541)."""
+    if func is None:
+        return lambda f: StencilKernel(f)
+    return StencilKernel(func)
+
+
+def _eval_stencil(static, *arrs):
+    func, lo, hi, slots = static
+    shape = arrs[0].shape
+    interior = tuple(
+        s - (h - l) for s, l, h in zip(shape, lo, hi)
+    )
+
+    def build_args(wrap):
+        out = []
+        for kind, payload in slots:
+            if kind == "arr":
+                out.append(_ShiftProxy(arrs[payload], lo, interior, wrap=wrap))
+            else:
+                out.append(payload.v)
+        return out
+
+    try:
+        val = func(*build_args(False))
+    except (jax.errors.TracerArrayConversionError, TypeError):
+        val = _unwrap(func(*build_args(True)))
+    val = _unwrap(val)
+    out = jnp.zeros(shape, val.dtype)
+    idx = tuple(slice(-l, -l + n) for l, n in zip(lo, interior))
+    return out.at[idx].set(val)
+
+
+defop("stencil")(_eval_stencil)
+
+
+def sstencil(st, arr, *args):
+    """Reference: ramba.sstencil (docs/index.md:190-215, ramba.py:9987-10054).
+    Border cells of the output are zero (the stencil writes only indices
+    where the full neighborhood is in range).  Extra args may be arrays
+    (element-aligned, relative-indexed) or literals of any type."""
+    if not isinstance(st, StencilKernel):
+        st = StencilKernel(st)
+    arr = asarray(arr)
+    full_args = [arr] + [
+        asarray(a) if isinstance(a, (np.ndarray, list)) else a for a in args
+    ]
+    slots, operands = _split_operands(tuple(full_args))
+    lo, hi = st.neighborhood(tuple(slots))
+    if len(lo) != arr.ndim:
+        raise ValueError(
+            f"stencil kernel indexes {len(lo)} dims but array has {arr.ndim}"
+        )
+    return ndarray(Node("stencil", (st.func, lo, hi, tuple(slots)), operands))
+
+
+# ---------------------------------------------------------------------------
+# scumulative
+# ---------------------------------------------------------------------------
+
+
+@defop("scumulative")
+def _op_scumulative(static, x):
+    local_func, final_func, nblocks = static
+    n = x.shape[0]
+    block = max(1, -(-n // nblocks))
+    nb = -(-n // block)
+
+    def local_scan(b):
+        def step(carry, xi):
+            y = jnp.where(carry[1], _call_kernel(local_func, xi, carry[0]), xi)
+            return (y, jnp.asarray(True)), y
+
+        (_, _), ys = jax.lax.scan(step, (jnp.zeros((), x.dtype), jnp.asarray(False)), b)
+        return ys
+
+    outs = []
+    prev_last = None
+    for i in range(nb):
+        piece = x[i * block: min((i + 1) * block, n)]
+        local = local_scan(piece)
+        if prev_last is None:
+            fixed = local
+        else:
+            fixed = _call_kernel(final_func, prev_last, local)
+        prev_last = fixed[-1]
+        outs.append(fixed)
+    return jnp.concatenate(outs, 0)
+
+
+def scumulative(local_func, final_func, arr):
+    """Reference: ramba.scumulative (docs/index.md:219-243,
+    ramba.py:10057-10115,3378-3437).  Blocks scan in parallel; the
+    carry chain across blocks is unrolled inside one compiled program
+    (nblocks = worker count, matching the reference's per-worker split)."""
+    arr = asarray(arr)
+    if arr.ndim != 1:
+        raise ValueError("scumulative requires a 1-D array")
+    nblocks = _mesh.num_workers()
+    return ndarray(
+        Node("scumulative", (local_func, final_func, nblocks),
+             [arr.read_expr()])
+    )
+
+
+# ---------------------------------------------------------------------------
+# spmd
+# ---------------------------------------------------------------------------
+
+
+class LocalView:
+    """Per-worker view of a distributed array inside ``spmd`` (reference:
+    LocalNdarray with get_local, ramba.py:1169-1357, docs/index.md:247-266).
+    ``set_local`` is the functional replacement for in-place shard mutation:
+    the updated block is written back to the source array after the call."""
+
+    def __init__(self, block):
+        self._block = block
+        self._updated = None
+
+    def get_local(self):
+        return self._block if self._updated is None else self._updated
+
+    def set_local(self, value):
+        self._updated = jnp.asarray(value, self._block.dtype)
+
+    @property
+    def shape(self):
+        return self.get_local().shape
+
+    @property
+    def dtype(self):
+        return self.get_local().dtype
+
+
+def worker_id():
+    """Inside ``spmd``: this worker's linear index (reference: worker_num
+    passed to every remote kernel)."""
+    m = _mesh.get_mesh()
+    idx = jnp.zeros((), jnp.int32)
+    mult = 1
+    for name in reversed(m.axis_names):
+        idx = idx + jax.lax.axis_index(name) * mult
+        mult *= m.shape[name]
+    return idx
+
+
+def spmd(func, *args):
+    """Reference: ramba.spmd (docs/index.md:247-266, ramba.py:10173-10180,
+    3477-3491).  Runs ``func`` once per mesh device under shard_map; ndarray
+    args arrive as LocalView shards; ``set_local`` updates propagate back."""
+    mesh = _mesh.get_mesh()
+    axes = tuple(mesh.axis_names)
+    arr_positions = [i for i, a in enumerate(args) if isinstance(a, ndarray)]
+    arrays = [args[i] for i in arr_positions]
+    vals = [a._value() for a in arrays]
+    specs = []
+    n_shards = int(np.prod([mesh.shape[a] for a in axes]))
+    for v in vals:
+        spec = _mesh.default_spec(v.shape, mesh)
+        if spec == P():
+            raise ValueError(
+                "spmd requires distributed arrays: an array of "
+                f"{int(np.prod(v.shape))} elements is replicated (below the "
+                f"RAMBA_DIST_THRESHOLD of {__import__('ramba_tpu').common.dist_threshold}), "
+                "so every worker would see the whole array"
+            )
+        # shard_map needs even divisibility along the sharded dims
+        for d, entry in enumerate(spec):
+            if entry is None:
+                continue
+            names = (entry,) if isinstance(entry, str) else tuple(entry)
+            k = int(np.prod([mesh.shape[nm] for nm in names]))
+            if v.shape[d] % k != 0:
+                raise ValueError(
+                    f"spmd: array dim {d} of size {v.shape[d]} is not "
+                    f"divisible by the {k}-way mesh split; pad the array or "
+                    f"reshape so each worker gets an equal block"
+                )
+        specs.append(spec)
+    vals = [
+        jax.device_put(v, NamedSharding(mesh, s)) for v, s in zip(vals, specs)
+    ]
+
+    def inner(*blocks):
+        views = [LocalView(b) for b in blocks]
+        call_args = list(args)
+        for p, v in zip(arr_positions, views):
+            call_args[p] = v
+        func(*call_args)
+        return tuple(v.get_local() for v in views)
+
+    outs = jax.shard_map(
+        inner, mesh=mesh, in_specs=tuple(specs), out_specs=tuple(specs),
+        check_vma=False,
+    )(*vals)
+    for a, new in zip(arrays, outs):
+        a.write_expr(Const(new))
+    return None
+
+
+def barrier():
+    """Reference: ramba.barrier (Ray BarrierActor, ramba.py:883-916) — here
+    simply a device sync."""
+    _sync()

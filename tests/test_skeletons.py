@@ -1,0 +1,342 @@
+"""Skeleton + groupby tests.
+
+Reference models: TestStencil and the skeleton examples in docs/index.md
+(/root/reference/ramba/tests/test_distributed_array.py,
+/root/reference/ramba/tests/test_groupby.py).
+"""
+
+import numpy as np
+import pytest
+
+import ramba_tpu as rt
+
+
+class TestSmap:
+    def test_smap_docs_example(self):
+        # docs/index.md smap example (f1 with numpy closure arg + scalar)
+        def f1(a, b, c, d):
+            return a * d + b - c[5]
+
+        a = rt.ones(100)
+        b = rt.zeros(100)
+        c = np.arange(20)
+        e = rt.smap(f1, a, b, c, 7)
+        np.testing.assert_allclose(e.asarray(), np.full(100, 2.0))
+
+    def test_smap_index_docs_example(self):
+        def f2(index, a, b):
+            return (a + b + index[0]) * index[0]
+
+        a = rt.ones(100)
+        b = rt.zeros(100)
+        f = rt.smap_index(f2, a, b)
+        i = np.arange(100)
+        np.testing.assert_allclose(f.asarray(), (1 + i) * i)
+
+    def test_smap_2d_index(self):
+        def f(index, a):
+            return a + index[0] * 10 + index[1]
+
+        a = rt.zeros((4, 5))
+        out = rt.smap_index(f, a).asarray()
+        i, j = np.mgrid[0:4, 0:5]
+        np.testing.assert_allclose(out, i * 10 + j)
+
+    def test_smap_fuses(self):
+        rt.sync()
+        before = dict(rt.fuser_stats)
+        a = rt.arange(100).astype(float)
+        b = rt.smap(lambda x: x * 2 + 1, a) + 5
+        rt.sync()
+        assert rt.fuser_stats["flushes"] == before["flushes"] + 1
+        np.testing.assert_allclose(b.asarray(), np.arange(100.0) * 2 + 6)
+
+
+class TestSreduce:
+    def test_sreduce_docs_example(self):
+        a = rt.init_array(100, lambda i: i * 11.0)
+        a -= 7
+        a = abs(a)
+        b = rt.sreduce(lambda x: x / 100, lambda x, y: x + y, 0, a)
+        expected = np.abs(np.arange(100) * 11.0 - 7).sum() / 100
+        assert float(b) == pytest.approx(expected)
+
+    def test_sreduce_index(self):
+        a = rt.ones(50)
+        r = rt.sreduce_index(
+            lambda idx, x: x * idx[0], lambda x, y: x + y, 0.0, a
+        )
+        assert float(r) == pytest.approx(sum(range(50)))
+
+    def test_sreduce_reducer_split(self):
+        a = rt.ones(64)
+        r = rt.sreduce(
+            lambda x: x,
+            rt.SreduceReducer(lambda x, y: x + y, lambda x, y: x + y),
+            0.0,
+            a,
+        )
+        assert float(r) == pytest.approx(64.0)
+
+    def test_sreduce_max(self):
+        a = rt.arange(100).astype(float)
+        r = rt.sreduce(lambda x: x, lambda x, y: np.maximum(x, y), -np.inf, a)
+        assert float(r) == 99.0
+
+
+class TestStencil:
+    def test_star_1d(self):
+        @rt.stencil
+        def avg3(a):
+            return (a[-1] + a[0] + a[1]) / 3.0
+
+        x = rt.arange(10).astype(float)
+        out = rt.sstencil(avg3, x).asarray()
+        e = np.zeros(10)
+        v = np.arange(10.0)
+        e[1:-1] = (v[:-2] + v[1:-1] + v[2:]) / 3.0
+        np.testing.assert_allclose(out, e)
+
+    def test_star_2d_5point(self):
+        @rt.stencil
+        def five(a):
+            return a[0, 0] + 0.25 * (a[-1, 0] + a[1, 0] + a[0, -1] + a[0, 1])
+
+        x = rt.fromarray(np.arange(64, dtype=float).reshape(8, 8))
+        out = rt.sstencil(five, x).asarray()
+        v = np.arange(64, dtype=float).reshape(8, 8)
+        e = np.zeros((8, 8))
+        e[1:-1, 1:-1] = v[1:-1, 1:-1] + 0.25 * (
+            v[:-2, 1:-1] + v[2:, 1:-1] + v[1:-1, :-2] + v[1:-1, 2:]
+        )
+        np.testing.assert_allclose(out, e)
+
+    def test_radius2_asymmetric(self):
+        @rt.stencil
+        def st(a):
+            return a[-2] + a[1]
+
+        x = rt.arange(12).astype(float)
+        out = rt.sstencil(st, x).asarray()
+        v = np.arange(12.0)
+        e = np.zeros(12)
+        e[2:-1] = v[0:-3] + v[3:]
+        np.testing.assert_allclose(out, e)
+
+    def test_two_array_stencil(self):
+        @rt.stencil
+        def st(a, b):
+            return a[1] - b[-1]
+
+        x = rt.arange(10).astype(float)
+        y = rt.ones(10)
+        out = rt.sstencil(st, x, y).asarray()
+        v = np.arange(10.0)
+        e = np.zeros(10)
+        e[1:-1] = v[2:] - 1.0
+        np.testing.assert_allclose(out, e)
+
+    def test_direct_numpy_call(self):
+        # reference: "using a Ramba stencil directly only NumPy arrays"
+        @rt.stencil
+        def st(a):
+            return a[-1] + a[1]
+
+        v = np.arange(8.0)
+        out = st(v)
+        e = np.zeros(8)
+        e[1:-1] = v[:-2] + v[2:]
+        np.testing.assert_allclose(out, e)
+
+    def test_dim_mismatch_raises(self):
+        @rt.stencil
+        def st(a):
+            return a[0, 0]
+
+        with pytest.raises(ValueError):
+            rt.sstencil(st, rt.arange(10))
+
+
+class TestScumulative:
+    def test_cumsum_equiv(self):
+        x = rt.arange(1, 101).astype(float)
+        out = rt.scumulative(
+            lambda xi, prev: xi + prev,
+            lambda carry, block: block + carry,
+            x,
+        )
+        np.testing.assert_allclose(out.asarray(), np.cumsum(np.arange(1, 101.0)))
+
+    def test_running_max(self):
+        v = np.array([3.0, 1.0, 4.0, 1.0, 5.0, 9.0, 2.0, 6.0, 5.0, 3.0] * 5)
+        x = rt.fromarray(v)
+        out = rt.scumulative(
+            lambda xi, prev: np.maximum(xi, prev),
+            lambda carry, block: np.maximum(block, carry),
+            x,
+        )
+        np.testing.assert_allclose(out.asarray(), np.maximum.accumulate(v))
+
+
+class TestSpmd:
+    def test_spmd_set_local(self):
+        a = rt.zeros(800)
+        rt.sync()
+
+        def worker(local):
+            blk = local.get_local()
+            local.set_local(blk + 1.0)
+
+        rt.spmd(worker, a)
+        np.testing.assert_allclose(a.asarray(), np.ones(800))
+
+    def test_spmd_worker_id(self):
+        a = rt.zeros(800)
+        rt.sync()
+
+        def worker(local):
+            wid = rt.worker_id()
+            local.set_local(local.get_local() + wid.astype(local.dtype))
+
+        rt.spmd(worker, a)
+        # 800 elements over 8 workers -> block i filled with worker id i
+        expected = np.repeat(np.arange(8.0), 100)
+        np.testing.assert_allclose(np.sort(a.asarray()), expected)
+
+    def test_barrier(self):
+        rt.barrier()
+
+
+class TestGroupby:
+    """Reference: test_groupby.py — verified against pandas-style manual
+    computation."""
+
+    def _data(self):
+        np.random.seed(0)
+        v = np.random.rand(12, 5)
+        labels = np.array([0, 1, 2, 0, 1, 2, 0, 1, 2, 0, 1, 2])
+        return v, labels
+
+    @pytest.mark.parametrize("red", ["sum", "mean", "min", "max", "prod",
+                                     "var", "std"])
+    def test_reductions(self, red):
+        v, labels = self._data()
+        g = rt.fromarray(v).groupby(0, labels, 3)
+        got = getattr(g, red)().asarray()
+        expected = np.stack(
+            [getattr(np, red)(v[labels == k], axis=0) for k in range(3)]
+        )
+        np.testing.assert_allclose(got, expected, rtol=1e-10)
+
+    def test_count(self):
+        v, labels = self._data()
+        g = rt.fromarray(v).groupby(0, labels, 3)
+        got = g.count().asarray()
+        assert (got == 4).all()
+
+    def test_nanmean(self):
+        v, labels = self._data()
+        v = v.copy()
+        v[0, 0] = np.nan
+        g = rt.fromarray(v).groupby(0, labels, 3)
+        got = g.nanmean().asarray()
+        expected = np.stack(
+            [np.nanmean(v[labels == k], axis=0) for k in range(3)]
+        )
+        np.testing.assert_allclose(got, expected, rtol=1e-10)
+
+    def test_anomaly_pattern(self):
+        # the xarray climatology/anomaly idiom the reference's rewrite
+        # rules recognize (ramba.py:4680-4789)
+        v, labels = self._data()
+        a = rt.fromarray(v)
+        g = a.groupby(0, labels, 3)
+        clim = g.mean()
+        anom = (g - clim).asarray()
+        expected = v - np.stack(
+            [np.mean(v[labels == k], axis=0) for k in range(3)]
+        )[labels]
+        np.testing.assert_allclose(anom, expected, rtol=1e-10)
+
+    def test_groupby_axis1(self):
+        v = np.arange(24, dtype=float).reshape(4, 6)
+        labels = np.array([0, 0, 1, 1, 1, 0])
+        g = rt.fromarray(v).groupby(1, labels, 2)
+        got = g.sum().asarray()
+        expected = np.stack(
+            [v[:, labels == k].sum(axis=1) for k in range(2)], axis=1
+        )
+        np.testing.assert_allclose(got, expected)
+
+    def test_bad_labels_raises(self):
+        with pytest.raises(ValueError):
+            rt.fromarray(np.zeros((4, 4))).groupby(0, np.array([0, 1]))
+
+
+class TestFileIO:
+    def test_npy_roundtrip(self, tmp_path):
+        p = str(tmp_path / "x.npy")
+        v = np.arange(100.0).reshape(10, 10)
+        rt.save(p, rt.fromarray(v))
+        back = rt.load(p)
+        np.testing.assert_allclose(back.asarray(), v)
+
+    def test_dataset_lazy(self, tmp_path):
+        p = str(tmp_path / "y.npy")
+        np.save(p, np.ones(5))
+        ds = rt.Dataset(p)
+        assert ds.shape == (5,)
+        np.testing.assert_allclose((ds[2:] + 1).asarray(), np.full(3, 2.0))
+
+    def test_unknown_extension(self):
+        with pytest.raises(ValueError):
+            rt.load("file.xyz")
+
+    def test_custom_loader(self, tmp_path):
+        def my_loader(path, key):
+            return rt.fromarray(np.full(3, 7.0))
+
+        rt.register_loader("myext", my_loader)
+        np.testing.assert_allclose(
+            rt.load(str(tmp_path / "a.myext")).asarray(), np.full(3, 7.0)
+        )
+
+
+class TestReviewRegressions2:
+    """Regressions for the round-1 second code-review pass."""
+
+    def test_sstencil_scalar_extra_arg(self):
+        @rt.stencil
+        def st(a, c):
+            return a[-1] + a[1] + c
+
+        x = rt.arange(10).astype(float)
+        out = rt.sstencil(st, x, 5.0).asarray()
+        v = np.arange(10.0)
+        e = np.zeros(10)
+        e[1:-1] = v[:-2] + v[2:] + 5.0
+        np.testing.assert_allclose(out, e)
+
+    def test_spmd_replicated_raises(self):
+        with pytest.raises(ValueError, match="replicated"):
+            rt.spmd(lambda l: None, rt.zeros(50))
+
+    def test_spmd_indivisible_raises(self):
+        with pytest.raises(ValueError, match="divisible"):
+            rt.spmd(lambda l: None, rt.zeros(801))
+
+    def test_groupby_scalar_binop(self):
+        v = np.arange(12, dtype=float).reshape(6, 2)
+        labels = np.array([0, 1, 0, 1, 0, 1])
+        g = rt.fromarray(v).groupby(0, labels, 2)
+        np.testing.assert_allclose((g * 2.0).asarray(), v * 2.0)
+        np.testing.assert_allclose((1.0 + g).asarray(), 1.0 + v)
+
+    def test_save_load_h5_extension_safe(self, tmp_path):
+        with pytest.raises(ValueError):
+            rt.save(str(tmp_path / "x.xyz"), rt.ones(3))
+        p = str(tmp_path / "x.npy")
+        rt.save(p, rt.ones(3))
+        import os
+
+        assert os.path.exists(p) and not os.path.exists(p + ".npy")
