@@ -62,6 +62,14 @@ from ramba_tpu.skeletons import (  # noqa: F401
 from ramba_tpu.groupby import RambaGroupby  # noqa: F401
 from ramba_tpu.fileio import Dataset, load, register_loader, save  # noqa: F401
 from ramba_tpu import random  # noqa: F401
+from ramba_tpu.parallel import distributed  # noqa: F401
+from ramba_tpu.parallel.constraints import (  # noqa: F401
+    Constraint, add_constraint, get_constraints,
+)
+from ramba_tpu.utils.remote import get, jit, remote  # noqa: F401
+from ramba_tpu.utils import debug  # noqa: F401
+from ramba_tpu.utils import timing  # noqa: F401
+from ramba_tpu.utils.timing import get_timing, timing_summary  # noqa: F401
 
 # -- numpy namespace constants / dtypes --------------------------------------
 newaxis = None

@@ -51,6 +51,10 @@ max_pending_ops = _env_int("RAMBA_TPU_MAX_PENDING", 10_000)
 # How many mesh axes the default mesh is factored into (1..3).
 mesh_ndim = _env_int("RAMBA_TPU_MESH_NDIM", 1)
 
+# Pattern-rewrite rules on the lazy graph (reference: DAG rewrites,
+# ramba.py:4567-4789; always on there — gated here for debugging).
+rewrite_enabled = _env_flag("RAMBA_TPU_REWRITE", True)
+
 # Forced number of devices ("workers"); default = all visible devices.
 num_workers_env = os.environ.get("RAMBA_WORKERS", None)
 

@@ -27,6 +27,8 @@ Differences, by design:
 
 from __future__ import annotations
 
+import hashlib
+import time
 import warnings
 import weakref
 from typing import Optional, Sequence
@@ -36,6 +38,7 @@ import jax
 from ramba_tpu import common
 from ramba_tpu.core.expr import Const, Expr, Node, Scalar, OPS
 from ramba_tpu.parallel import mesh as _mesh
+from ramba_tpu.utils import timing as _timing
 
 # Donation is pointless for small buffers and fragments the jit cache (the
 # donate mask is part of the compile key); only donate above this size.
@@ -200,18 +203,42 @@ def _build_callable(program: _Program):
     return run
 
 
+def _pending_roots() -> list:
+    """Pending ndarrays in deterministic (creation) order — the program the
+    next flush will run is defined by this set."""
+    roots = [a for a in _pending_arrays() if not isinstance(a._expr, Const)]
+    roots.sort(key=lambda a: a._seq)
+    return roots
+
+
+def _prepare_program(exprs: Sequence[Expr]):
+    """Rewrite + linearize — shared by flush() and analyze_pending() so both
+    always see the identical program."""
+    if common.rewrite_enabled:
+        from ramba_tpu.core.rewrite import rewrite_roots
+
+        exprs = rewrite_roots(exprs)
+    return _linearize(exprs)
+
+
+def _program_label(program: _Program) -> str:
+    """Stable per-structure label for profiling: hashes only the op sequence
+    (statics can hold closures whose repr embeds memory addresses) — the
+    reference names kernels sha256(code), ramba.py:8260-8265."""
+    text = " ".join(op for op, _, _ in program.instrs) + f"|{program.n_leaves}"
+    return "prog_" + hashlib.sha256(text.encode()).hexdigest()[:12]
+
+
 def flush(extra: Sequence[Expr] = ()) -> list:
     """Materialize every pending ndarray (and ``extra`` expressions) in one
     fused jit call.  Returns the values of ``extra`` in order."""
     global _nodes_since_flush
     _nodes_since_flush = 0
-    roots = [a for a in _pending_arrays() if not isinstance(a._expr, Const)]
-    # Deterministic order across flushes with the same pending set:
-    roots.sort(key=lambda a: a._seq)
+    roots = _pending_roots()
     exprs = [a._expr for a in roots] + list(extra)
     if not exprs:
         return []
-    program, leaves = _linearize(exprs)
+    program, leaves = _prepare_program(exprs)
 
     donate = []
     leaf_vals = []
@@ -233,7 +260,8 @@ def flush(extra: Sequence[Expr] = ()) -> list:
         _cache_epoch = _mesh.mesh_epoch
     key = (program.key, donate_key)
     fn = _compile_cache.get(key)
-    if fn is None:
+    is_new = fn is None
+    if is_new:
         if len(_compile_cache) >= _COMPILE_CACHE_MAX:
             _compile_cache.pop(next(iter(_compile_cache)))
         fn = jax.jit(_build_callable(program), donate_argnums=donate_key)
@@ -242,19 +270,69 @@ def flush(extra: Sequence[Expr] = ()) -> list:
         if common.show_code:
             import sys
 
+            # jaxpr + lowered StableHLO (the reference's RAMBA_SHOW_CODE
+            # dumps the generated Numba source, ramba.py:8266-8284).
+            # Lowering only — compiling here would build a throwaway AOT
+            # executable the jit call below cannot reuse.
             print(
                 jax.make_jaxpr(_build_callable(program))(*leaf_vals),
                 file=sys.stderr,
             )
+            try:
+                print(fn.lower(*leaf_vals).as_text()[:20000], file=sys.stderr)
+            except Exception:
+                pass
     stats["flushes"] += 1
     stats["nodes_flushed"] += len(program.instrs)
+    t0 = time.perf_counter()
     with warnings.catch_warnings():
         warnings.filterwarnings("ignore", message=".*[Dd]onat.*")
         outs = fn(*leaf_vals)
+    dt = time.perf_counter() - t0
+    if is_new:
+        # jax.jit compiles lazily: the first call pays trace+lower+XLA
+        # compile.  Attribute it separately so per-program execution times
+        # stay comparable.
+        _timing.add_time("trace_compile_first_call", dt)
+    else:
+        _timing.add_time("flush_execute", dt)
+        _timing.add_func_time(_program_label(program), dt)
     del leaf_vals
     for arr, val in zip(roots, outs[: len(roots)]):
         arr._set_expr(Const(val))
     return list(outs[len(roots):])
+
+
+def analyze_pending() -> Optional[dict]:
+    """Compile (without executing) the program the next flush would run and
+    return XLA's memory analysis — the rebuild's answer to the reference's
+    CI memory-behavior tests, which assert that giant fused expressions fit
+    in RAM only if no temporaries materialize
+    (/root/reference/ramba/tests/test_distributed_array.py:100-108,193-199).
+    The pending graph is left pending.  Returns None if nothing is pending.
+    """
+    roots = _pending_roots()
+    exprs = [a._expr for a in roots]
+    if not exprs:
+        return None
+    program, leaves = _prepare_program(exprs)
+    avals = []
+    for leaf in leaves:
+        v = leaf.value
+        if isinstance(v, jax.Array):
+            avals.append(
+                jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=v.sharding)
+            )
+        else:
+            avals.append(jax.ShapeDtypeStruct(jax.numpy.asarray(v).shape,
+                                              jax.numpy.asarray(v).dtype))
+    compiled = jax.jit(_build_callable(program)).lower(*avals).compile()
+    ma = compiled.memory_analysis()
+    out = {"instructions": len(program.instrs), "n_leaves": program.n_leaves}
+    for name in ("temp_size_in_bytes", "argument_size_in_bytes",
+                 "output_size_in_bytes", "generated_code_size_in_bytes"):
+        out[name] = getattr(ma, name, None)
+    return out
 
 
 def sync() -> None:

@@ -1,0 +1,306 @@
+"""Pattern-rewrite rules over the lazy expression graph.
+
+TPU-native rebuild of the reference's DAG peephole rewrites
+(/root/reference/ramba/ramba.py:4567-4789), which recognize the op patterns
+xarray emits for groupby workloads (docs/index.md:53-58) and replace them
+with direct implementations:
+
+* ``rewrite_arange_reshape`` (:4567-4598) — ``arange(n).reshape(s)`` becomes
+  a direct per-index filler.  Here that means generating values in the
+  *target* sharding via broadcasted iotas instead of materializing a 1-D
+  sharded iota and paying an all-to-all reshard on the reshape.
+* ``rewrite_stack_mean_advindex`` (:4601-4677) — ``stack([reduce(x[:, idx_g])
+  for g])`` (the xarray ``groupby().mean()`` expansion) becomes ONE segment
+  reduction instead of k gathers + k reductions + a stack.
+* ``rewrite_concatenate_binop_getitem`` (:4680-4789) — ``concatenate([
+  x[:, idx_g] ∘ m[g] for g])`` (the xarray anomaly pattern) becomes two
+  gathers + one fused elementwise op.
+
+Rules run bottom-up once per flush (core/fuser.py); a rule returns a
+replacement Node or None.  All matching is defensive: any structural
+mismatch leaves the graph untouched.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ramba_tpu.core.expr import Const, Expr, Node
+
+REDUCE_KINDS = {"mean", "nanmean", "sum", "nansum", "min", "max", "prod"}
+
+
+def rewrite_arange_reshape(node: Node):
+    """reshape(arange) -> fromfunction in the target shape/sharding
+    (reference: ramba.py:4567-4598)."""
+    if node.op != "reshape":
+        return None
+    (shape,) = node.static
+    arg = node.args[0]
+    if not (isinstance(arg, Node) and arg.op == "arange"):
+        return None
+    n, dtype, _spec = arg.static
+    from ramba_tpu.parallel import mesh as _mesh
+
+    spec = tuple(_mesh.default_spec(shape))
+    start, step = arg.args
+    shape = tuple(int(s) for s in shape)
+    strides = []
+    acc = 1
+    for s in reversed(shape):
+        strides.append(acc)
+        acc *= s
+    strides = tuple(reversed(strides))
+    idx_dtype = "int64" if n > 2**31 else "int32"
+
+    def fill_fn(*a):
+        import jax.numpy as jnp
+
+        idx = a[:-2]
+        start_v, step_v = a[-2:]
+        flat = 0
+        for i, st in zip(idx, strides):
+            flat = flat + i.astype(jnp.dtype(idx_dtype)) * st
+        return (start_v + step_v * flat).astype(jnp.dtype(dtype))
+
+    # hashable wrapper for cache stability across flushes
+    filler = _HashedFill(("arange_reshape", shape, str(dtype), idx_dtype),
+                         fill_fn)
+    return Node(
+        "fromfunction", (shape, dtype, spec, filler, True),
+        [start, step], aval=None,
+    )
+
+
+class _HashedFill:
+    """Wrap a function with a value-based hash key so structurally identical
+    rewrites share one compile-cache entry."""
+
+    __slots__ = ("key", "fn")
+
+    def __init__(self, key, fn):
+        self.key = key
+        self.fn = fn
+
+    def __call__(self, *args):
+        return self.fn(*args)
+
+    def __hash__(self):
+        return hash(self.key)
+
+    def __eq__(self, other):
+        return isinstance(other, _HashedFill) and other.key == self.key
+
+
+def _single_axis_gather(e: Expr):
+    """Match getitem_adv with exactly one integer index array and full slices
+    elsewhere.  Returns (base_expr, dim, index_const) or None."""
+    if not (isinstance(e, Node) and e.op == "getitem_adv"):
+        return None
+    enc, arraypos = e.static
+    if len(arraypos) != 1:
+        return None
+    dim = 0
+    p = arraypos[0]
+    for q, part in enumerate(enc):
+        if q == p:
+            break
+        if part[0] == "n":
+            return None
+        if part[0] == "s" and part[1:] != (None, None, None):
+            return None
+        if part[0] == "i":
+            return None
+        dim += 1
+    for q, part in enumerate(enc):
+        if q == p:
+            continue
+        if part[0] != "s" or part[1:] != (None, None, None):
+            return None
+    idx = e.args[1]
+    if not isinstance(idx, Const):
+        return None
+    return e.args[0], dim, idx
+
+
+def rewrite_stack_reduce_advindex(node: Node):
+    """stack([reduce(x[..., idx_g, ...], axis=dim) for g]) -> segment_reduce
+    (reference: rewrite_stack_mean_advindex, ramba.py:4601-4677)."""
+    if node.op != "stack" or len(node.args) < 2:
+        return None
+    (stack_axis,) = node.static
+    kind = None
+    dim = None
+    base = None
+    groups = []
+    for a in node.args:
+        if not (isinstance(a, Node) and a.op == "reduce"):
+            return None
+        k, raxis, keepdims, ddof = a.static
+        if k not in REDUCE_KINDS or keepdims or ddof not in (None, 0):
+            return None
+        m = _single_axis_gather(a.args[0])
+        if m is None:
+            return None
+        b, d, idx = m
+        if raxis != d:
+            return None
+        if base is None:
+            base, dim, kind = b, d, k
+        elif b is not base or d != dim or k != kind:
+            return None
+        groups.append(np.asarray(idx.value))
+    # full, disjoint coverage of the grouped dimension
+    n = base.aval.shape[dim]
+    labels = np.full((n,), -1, np.int64)
+    for g, idx in enumerate(groups):
+        if idx.ndim != 1:
+            return None
+        if np.any(labels[idx] != -1):
+            return None
+        labels[idx] = g
+    if np.any(labels < 0):
+        return None
+    out = Node(
+        "segment_reduce",
+        (kind, len(groups), dim),
+        [base, Const(_to_device(labels.astype(np.int32)))],
+    )
+    # segment_reduce leaves groups on `dim`; stack puts them on stack_axis.
+    if stack_axis != dim:
+        out = Node("moveaxis", (dim, stack_axis), [out])
+    return out
+
+
+def rewrite_concat_binop_getitem(node: Node):
+    """concatenate([binop(x[..., idx_g, ...], m[g]) for g]) ->
+    binop(gather(x, cat(idx)), gather(m, group_of_position))
+    (reference: rewrite_concatenate_binop_getitem, ramba.py:4680-4789)."""
+    if node.op != "concatenate" or len(node.args) < 2:
+        return None
+    (axis,) = node.static
+    base = None
+    dim = None
+    fname = None
+    m_base = None
+    swapped = None
+    groups = []
+    for gi, a in enumerate(node.args):
+        if not (isinstance(a, Node) and a.op == "map" and len(a.args) == 2):
+            return None
+        (f,) = a.static
+        lhs, rhs = a.args
+        gl = _single_axis_gather(lhs)
+        gr = _single_axis_gather(rhs)
+        if gl is not None and gr is None:
+            gather, other, sw = gl, rhs, False
+        elif gr is not None and gl is None:
+            gather, other, sw = gr, lhs, True
+        else:
+            return None
+        b, d, idx = gather
+        # other must be m[g]: a getitem selecting integer g on one dim
+        sel = _int_select(other, gi)
+        if sel is None:
+            return None
+        mb, mdim = sel
+        if base is None:
+            base, dim, fname, m_base, swapped, m_dim = b, d, f, mb, sw, mdim
+        elif (b is not base or d != dim or f != fname or mb is not m_base
+              or sw != swapped or mdim != m_dim):
+            return None
+        groups.append(np.asarray(idx.value))
+    if axis != dim:
+        return None
+    cat_idx = np.concatenate(groups)
+    pos_group = np.concatenate(
+        [np.full((len(g),), gi, np.int32) for gi, g in enumerate(groups)]
+    )
+    ndim = base.aval.ndim
+    enc = tuple(
+        ("i", 0) if q == dim else ("s", None, None, None) for q in range(ndim)
+    )
+    gathered_x = Node(
+        "getitem_adv", (enc, (dim,)),
+        [base, Const(_to_device(cat_idx))],
+    )
+    gathered_m = Node(
+        "take", (m_dim, "clip"), [m_base, Const(_to_device(pos_group))]
+    )
+    args = [gathered_m, gathered_x] if swapped else [gathered_x, gathered_m]
+    return Node("map", (fname,), args)
+
+
+def _int_select(e: Expr, expect: int):
+    """Match getitem picking integer index ``expect`` on exactly one dim,
+    full slices elsewhere.  Returns (base, dim) or None."""
+    if not (isinstance(e, Node) and e.op == "getitem"):
+        return None
+    (enc,) = e.static
+    dim = None
+    at = 0
+    for part in enc:
+        if part[0] == "i":
+            if dim is not None or part[1] != expect:
+                return None
+            dim = at
+            at += 1
+        elif part[0] == "s" and part[1:] == (None, None, None):
+            at += 1
+        else:
+            return None
+    if dim is None:
+        return None
+    return e.args[0], dim
+
+
+def _to_device(x: np.ndarray):
+    import jax.numpy as jnp
+
+    return jnp.asarray(x)
+
+
+RULES = [
+    rewrite_arange_reshape,
+    rewrite_stack_reduce_advindex,
+    rewrite_concat_binop_getitem,
+]
+
+
+def rewrite_roots(roots):
+    """Apply RULES bottom-up across the expression forest (iterative — chains
+    can be deeper than the Python recursion limit, cf. the fuser's iterative
+    linearizer)."""
+    memo: dict[int, Expr] = {}
+    out = []
+    for root in roots:
+        stack = [(root, False)]
+        while stack:
+            e, done = stack.pop()
+            if id(e) in memo:
+                continue
+            if not isinstance(e, Node):
+                memo[id(e)] = e
+                continue
+            if not done:
+                stack.append((e, True))
+                for a in e.args:
+                    if id(a) not in memo:
+                        stack.append((a, False))
+                continue
+            new_args = [memo[id(a)] for a in e.args]
+            if all(n is o for n, o in zip(new_args, e.args)):
+                cand = e
+            else:
+                cand = Node(e.op, e.static, new_args, aval=e.aval)
+            for rule in RULES:
+                try:
+                    r = rule(cand)
+                except Exception:
+                    r = None
+                if r is not None:
+                    cand = r
+                    break
+            memo[id(e)] = cand
+        out.append(memo[id(root)])
+    return out

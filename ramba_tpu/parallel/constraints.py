@@ -1,0 +1,98 @@
+"""Co-partitioning constraints between related arrays.
+
+Reference (/root/reference/ramba/ramba.py): symbolic per-dimension
+constraints — ``smap(axis=...)`` records that its operands must be
+partitioned identically along an axis (:9915-9922); ``Constraint``/
+``add_constraint`` (:5296-5315) collect them, ``get_unified_constraints``
+(:4205-4277) unifies them across the DAG, and the partition solver
+(``compute_multi_partition``, common.py:344-451) turns them into per-array
+block schedules.
+
+TPU-native: a constraint is a shared ``PartitionSpec``.  Mesh axes are
+assigned to the constrained dimension and a ``with_sharding_constraint``
+hint node is pushed onto each array's expression, so GSPMD lays every
+constrained array out identically — the communication-free alignment the
+reference's solver computes by hand.  Unification across chained ops is
+GSPMD sharding propagation itself.
+"""
+
+from __future__ import annotations
+
+import weakref
+from collections import deque
+from typing import Sequence
+
+from jax.sharding import PartitionSpec as P
+
+from ramba_tpu.core.expr import Node
+from ramba_tpu.core.ndarray import ndarray
+from ramba_tpu.parallel import mesh as _mesh
+
+# Recorded constraints, for introspection/debugging (reference keeps the
+# live list on the DAG and dumps it with RAMBA_DEBUG).  Bounded, and
+# Constraint holds only weakrefs, so recording never pins arrays (or their
+# device buffers) alive.
+_constraints: deque = deque(maxlen=1024)
+
+
+class Constraint:
+    """"These arrays are partitioned only along ``axis``, identically."
+
+    Reference: class Constraint (ramba.py:5296-5315)."""
+
+    def __init__(self, arrays: Sequence[ndarray], axis: int):
+        self._array_refs = [weakref.ref(a) for a in arrays]
+        self.axis = int(axis)
+        ndim = arrays[0].ndim if arrays else 1
+        self.spec = axis_spec(ndim, axis)
+
+    @property
+    def arrays(self) -> list:
+        """Still-live constrained arrays."""
+        return [a for a in (r() for r in self._array_refs) if a is not None]
+
+    def __repr__(self):
+        return (f"Constraint(axis={self.axis}, n={len(self._array_refs)}, "
+                f"spec={self.spec})")
+
+
+def axis_spec(ndim: int, axis: int) -> P:
+    """PartitionSpec placing every mesh axis on ``axis`` (replicating the
+    rest) — the distribution the reference's solver produces for a
+    single-axis constraint."""
+    axis = axis % ndim
+    mesh = _mesh.get_mesh()
+    names = tuple(mesh.axis_names)
+    entries: list = [None] * ndim
+    entries[axis] = names[0] if len(names) == 1 else names
+    while entries and entries[-1] is None:
+        entries.pop()
+    return P(*entries)
+
+
+def add_constraint(arrays: Sequence[ndarray], axis: int) -> Constraint:
+    """Constrain ``arrays`` to be co-partitioned along ``axis`` (reference:
+    add_constraint, ramba.py:5296-5315).  Applied immediately as sharding
+    hints on each array's pending expression."""
+    arrs = [a for a in arrays if isinstance(a, ndarray)]
+    con = Constraint(arrs, axis)
+    for a in arrs:
+        if a.ndim == 0:
+            continue
+        spec = axis_spec(a.ndim, axis)
+        # divisibility guard: with_sharding_constraint handles uneven shards,
+        # but axis size smaller than the mesh would force replication anyway
+        k = _mesh.num_workers()
+        if a.shape[axis % a.ndim] < k:
+            continue
+        a.write_expr(Node("shard_hint", (tuple(spec),), [a.read_expr()]))
+    _constraints.append(con)
+    return con
+
+
+def get_constraints() -> list:
+    return list(_constraints)
+
+
+def clear_constraints() -> None:
+    _constraints.clear()

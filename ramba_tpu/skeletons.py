@@ -188,15 +188,28 @@ def _op_smap(static, *arrs):
     return vec(*arrs)
 
 
-def smap(func: Callable, arr, *args):
+def _maybe_constrain(all_args, axis):
+    """smap's axis kwarg records a co-partitioning constraint between the
+    operands (reference: ramba.py:9915-9922); here it pins every ndarray
+    operand to the same single-axis sharding."""
+    if axis is None:
+        return
+    from ramba_tpu.parallel.constraints import add_constraint
+
+    add_constraint([a for a in all_args if isinstance(a, ndarray)], axis)
+
+
+def smap(func: Callable, arr, *args, axis=None):
     """Reference: ramba.smap (docs/index.md:92-137, ramba.py:9863-9931)."""
     arr = asarray(arr)
+    _maybe_constrain((arr,) + args, axis)
     slots, operands = _split_operands((arr,) + args)
     return ndarray(Node("smap", (func, tuple(slots), False, arr.ndim), operands))
 
 
-def smap_index(func: Callable, arr, *args):
+def smap_index(func: Callable, arr, *args, axis=None):
     arr = asarray(arr)
+    _maybe_constrain((arr,) + args, axis)
     slots, operands = _split_operands((arr,) + args)
     return ndarray(Node("smap", (func, tuple(slots), True, arr.ndim), operands))
 
@@ -339,9 +352,12 @@ class StencilKernel:
     def neighborhood(self, slots):
         """Probe the kernel: array slots get offset-recording proxies,
         literal slots get their real values (additional sstencil args 'may be
-        of any type', docs/index.md)."""
-        cache_key = tuple(kind for kind, _ in slots)
-        if self._probe_cache is None or self._probe_key != cache_key:
+        of any type', docs/index.md).  Only cacheable when the kernel takes
+        no literal args — literal values can steer which offsets are read."""
+        has_literals = any(kind == "lit" for kind, _ in slots)
+        cache_key = None if has_literals else tuple(kind for kind, _ in slots)
+        if (has_literals or self._probe_cache is None
+                or self._probe_key != cache_key):
             probes = []
             call_args = []
             for kind, payload in slots:

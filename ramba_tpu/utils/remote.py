@@ -1,0 +1,135 @@
+"""`jit` and `remote` — the reference's Ray-integration extras, TPU-native.
+
+Reference (/root/reference/ramba/ramba.py:549-874):
+
+* ``ramba.jit`` rewrites class methods so Numba can compile them (it scans
+  tokens and turns ``self.x`` into parameters).  Here the compiler is XLA, so
+  ``jit`` is a thin adapter over ``jax.jit`` that understands ramba_tpu
+  ``ndarray`` arguments (flushing their lazy graphs, passing their sharded
+  jax.Array values) and re-wraps array results.
+* ``ramba.remote`` wraps functions/classes as Ray remote actors/tasks.  There
+  is no Ray here — the controller process drives the whole TPU mesh — so
+  ``remote`` provides the same *call surface* (``.remote(...)`` returning a
+  future, ``ramba_tpu.get(...)`` to resolve) over a host thread pool.  Device
+  work launched from any thread still serializes through the jax runtime;
+  the thread pool overlaps the host-side (IO/python) portions.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import functools
+from typing import Any
+
+import jax
+import numpy as np
+
+from ramba_tpu.core.expr import Const
+from ramba_tpu.core.ndarray import ndarray
+
+
+def _lower_arg(a):
+    if isinstance(a, ndarray):
+        return a._value()
+    return a
+
+
+def _lift_result(r):
+    if isinstance(r, jax.Array) and r.ndim > 0:
+        return ndarray(Const(r))
+    return r
+
+
+def jit(fn=None, **jit_kwargs):
+    """Compile ``fn`` with XLA; ndarray args are passed as their sharded
+    device values and array results come back as lazy-capable ndarrays.
+
+    Reference: ramba.jit (ramba.py:549-874).  The de-objectification the
+    reference performs for Numba is unnecessary — jax traces through Python
+    attribute access natively.
+    """
+    if fn is None:
+        return lambda f: jit(f, **jit_kwargs)
+
+    jfn = jax.jit(fn, **jit_kwargs)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        args = jax.tree.map(
+            _lower_arg, args, is_leaf=lambda x: isinstance(x, ndarray)
+        )
+        kwargs = jax.tree.map(
+            _lower_arg, kwargs, is_leaf=lambda x: isinstance(x, ndarray)
+        )
+        out = jfn(*args, **kwargs)
+        return jax.tree.map(
+            _lift_result, out, is_leaf=lambda x: isinstance(x, jax.Array)
+        )
+
+    wrapper._jitted = jfn
+    return wrapper
+
+
+_pool: concurrent.futures.ThreadPoolExecutor | None = None
+
+
+def _get_pool() -> concurrent.futures.ThreadPoolExecutor:
+    global _pool
+    if _pool is None:
+        _pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="ramba_tpu_remote"
+        )
+    return _pool
+
+
+class _RemoteFunction:
+    """Callable with the Ray-remote call surface (reference wraps with
+    ray.remote at ramba.py:549-660)."""
+
+    def __init__(self, fn):
+        self._fn = fn
+        functools.update_wrapper(self, fn)
+
+    def remote(self, *args, **kwargs) -> concurrent.futures.Future:
+        return _get_pool().submit(self._fn, *args, **kwargs)
+
+    def __call__(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+
+class _RemoteActorHandle:
+    def __init__(self, cls, args, kwargs):
+        self._obj = cls(*args, **kwargs)
+
+    def __getattr__(self, name):
+        method = getattr(self._obj, name)
+
+        class _M:
+            def remote(_self, *a, **kw):
+                return _get_pool().submit(method, *a, **kw)
+
+        return _M()
+
+
+class _RemoteClass:
+    def __init__(self, cls):
+        self._cls = cls
+
+    def remote(self, *args, **kwargs) -> _RemoteActorHandle:
+        return _RemoteActorHandle(self._cls, args, kwargs)
+
+
+def remote(obj):
+    """Reference: ramba.remote (ramba.py:549-874)."""
+    if isinstance(obj, type):
+        return _RemoteClass(obj)
+    return _RemoteFunction(obj)
+
+
+def get(future_or_list: Any):
+    """Resolve futures from ``remote`` (the ray.get analog)."""
+    if isinstance(future_or_list, (list, tuple)):
+        return type(future_or_list)(get(f) for f in future_or_list)
+    if isinstance(future_or_list, concurrent.futures.Future):
+        return future_or_list.result()
+    return future_or_list

@@ -1,0 +1,117 @@
+"""Timing / profiling registry.
+
+TPU-native rebuild of the reference's opt-in timing subsystem:
+
+* ``time_dict`` counters with ``add_time``/``add_sub_time``
+  (/root/reference/ramba/ramba.py:923-1019),
+* ``RAMBA_TIMING`` gated prints + atexit ``timing_summary``
+  (/root/reference/ramba/ramba.py:7620-7627),
+* per-fused-function execution times (``per_func``,
+  /root/reference/ramba/ramba.py:3794-3817), and
+* compile-time accounting (the reference listens to Numba compile events,
+  ramba.py:939-982; here the analogous cost is jax trace+lower+compile time,
+  measured around the jit cache miss in core/fuser.py).
+
+There are no worker processes to aggregate from (the reference gathers
+worker timers over RPC in ``get_timing``, ramba.py:3840-3848): one controller
+process drives the TPU mesh, so all timers live here.
+"""
+
+from __future__ import annotations
+
+import atexit
+import sys
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Optional
+
+from ramba_tpu import common
+
+# name -> [total_seconds, call_count]
+time_dict: dict = defaultdict(lambda: [0.0, 0])
+# (parent, name) -> [total_seconds, call_count]
+sub_time_dict: dict = defaultdict(lambda: [0.0, 0])
+# program label -> [total_seconds, call_count]  (reference: per_func)
+per_func: dict = defaultdict(lambda: [0.0, 0])
+
+
+def add_time(name: str, seconds: float) -> None:
+    """Accumulate into a top-level timer (reference: add_time,
+    ramba.py:923-940)."""
+    ent = time_dict[name]
+    ent[0] += seconds
+    ent[1] += 1
+
+
+def add_sub_time(parent: str, name: str, seconds: float) -> None:
+    """Accumulate into a nested timer (reference: add_sub_time)."""
+    ent = sub_time_dict[(parent, name)]
+    ent[0] += seconds
+    ent[1] += 1
+
+
+_PER_FUNC_MAX = 1024
+
+
+def add_func_time(label: str, seconds: float) -> None:
+    """Per-fused-program execution time (reference: per_func,
+    ramba.py:3794-3817).  Bounded: beyond _PER_FUNC_MAX distinct labels,
+    new ones aggregate under "<other>" so a program generating unbounded
+    distinct structures can't grow this dict forever."""
+    if label not in per_func and len(per_func) >= _PER_FUNC_MAX:
+        label = "<other>"
+    ent = per_func[label]
+    ent[0] += seconds
+    ent[1] += 1
+
+
+@contextmanager
+def timer(name: str, parent: Optional[str] = None):
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        if parent is None:
+            add_time(name, dt)
+        else:
+            add_sub_time(parent, name, dt)
+
+
+def reset() -> None:
+    time_dict.clear()
+    sub_time_dict.clear()
+    per_func.clear()
+
+
+def get_timing() -> dict:
+    """Snapshot of all timers (reference: get_timing aggregates driver and
+    worker timers, ramba.py:3840-3848)."""
+    return {
+        "timers": {k: tuple(v) for k, v in time_dict.items()},
+        "sub_timers": {k: tuple(v) for k, v in sub_time_dict.items()},
+        "per_func": {k: tuple(v) for k, v in per_func.items()},
+    }
+
+
+def timing_summary(file=None) -> None:
+    """Human-readable dump (reference: timing_summary at exit,
+    ramba.py:7620-7627)."""
+    file = file or sys.stderr
+    if not (time_dict or per_func):
+        return
+    print("=== ramba_tpu timing summary ===", file=file)
+    for name, (tot, cnt) in sorted(time_dict.items(), key=lambda kv: -kv[1][0]):
+        print(f"  {name:<28s} {tot:10.4f}s  x{cnt}", file=file)
+        for (parent, sub), (stot, scnt) in sorted(sub_time_dict.items()):
+            if parent == name:
+                print(f"    {sub:<26s} {stot:10.4f}s  x{scnt}", file=file)
+    if per_func:
+        print("  -- per fused program --", file=file)
+        for label, (tot, cnt) in sorted(per_func.items(), key=lambda kv: -kv[1][0]):
+            print(f"  {label:<28s} {tot:10.4f}s  x{cnt}", file=file)
+
+
+if common.timing_level > 0:
+    atexit.register(timing_summary)

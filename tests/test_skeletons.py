@@ -85,6 +85,26 @@ class TestSreduce:
 
 
 class TestStencil:
+    def test_literal_steered_offsets_not_cached(self):
+        # regression: the probed neighborhood must not be cached across calls
+        # whose literal args change which offsets the kernel reads
+        @rt.stencil
+        def spread(a, offs):
+            s = a[0] * 0.0
+            for o in offs:
+                s = s + a[o]
+            return s
+
+        x = np.arange(8.0)
+        wide = rt.sstencil(spread, rt.fromarray(x), (-2, 2)).asarray()
+        narrow = rt.sstencil(spread, rt.fromarray(x), (-1, 1)).asarray()
+        e_wide = np.zeros(8)
+        e_wide[2:-2] = x[:-4] + x[4:]
+        e_narrow = np.zeros(8)
+        e_narrow[1:-1] = x[:-2] + x[2:]
+        np.testing.assert_allclose(wide, e_wide)
+        np.testing.assert_allclose(narrow, e_narrow)
+
     def test_star_1d(self):
         @rt.stencil
         def avg3(a):

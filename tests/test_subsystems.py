@@ -1,0 +1,274 @@
+"""Tests for the auxiliary subsystems: timing, debug artifacts, pattern
+rewrites, constraints, jit/remote, distributed bring-up.
+
+Reference test model: everything end-to-end differential vs NumPy
+(/root/reference/ramba/tests/test_distributed_array.py:240-260 run_both).
+"""
+
+import numpy as np
+import pytest
+
+import ramba_tpu as rt
+from ramba_tpu.core import fuser
+from ramba_tpu.core.expr import Node
+from ramba_tpu.core.rewrite import rewrite_roots
+
+
+# ---------------------------------------------------------------------------
+# timing
+# ---------------------------------------------------------------------------
+
+
+class TestTiming:
+    def test_counters_accumulate(self):
+        from ramba_tpu.utils import timing
+
+        timing.add_time("unit_test", 1.5)
+        timing.add_time("unit_test", 0.5)
+        timing.add_sub_time("unit_test", "sub", 0.25)
+        snap = timing.get_timing()
+        assert snap["timers"]["unit_test"] == (2.0, 2)
+        assert snap["sub_timers"][("unit_test", "sub")] == (0.25, 1)
+
+    def test_flush_records_exec_and_per_func(self):
+        from ramba_tpu.utils import timing
+
+        timing.reset()
+        for _ in range(2):  # 2nd run is a guaranteed compile-cache hit
+            a = rt.arange(1000) * 2.0
+            rt.sync()
+        snap = timing.get_timing()
+        assert snap["timers"].get("flush_execute", (0, 0))[1] >= 1
+        assert len(snap["per_func"]) >= 1
+
+    def test_summary_prints(self, capsys):
+        import io
+
+        from ramba_tpu.utils import timing
+
+        timing.add_time("printable", 0.1)
+        buf = io.StringIO()
+        timing.timing_summary(file=buf)
+        assert "printable" in buf.getvalue()
+
+    def test_timer_context(self):
+        from ramba_tpu.utils import timing
+
+        timing.reset()
+        with timing.timer("ctx"):
+            pass
+        assert timing.time_dict["ctx"][1] == 1
+
+
+# ---------------------------------------------------------------------------
+# debug artifacts
+# ---------------------------------------------------------------------------
+
+
+class TestDebug:
+    def test_output_dot(self, tmp_path):
+        from ramba_tpu.utils import debug
+
+        a = rt.arange(100) + 1.0
+        b = rt.sin(a)
+        path = tmp_path / "g.dot"
+        text = debug.output_dot(str(path))
+        assert "digraph" in text
+        assert "map" in text
+        assert path.exists()
+        rt.sync()
+
+    def test_report_pending(self):
+        import io
+
+        from ramba_tpu.utils import debug
+
+        rt.sync()
+        a = rt.arange(50) * 3
+        buf = io.StringIO()
+        n = debug.report_pending(file=buf)
+        assert n >= 1
+        assert "pending" in buf.getvalue()
+        rt.sync()
+        buf2 = io.StringIO()
+        assert debug.report_pending(file=buf2) == 0
+
+
+# ---------------------------------------------------------------------------
+# pattern rewrites (reference: ramba.py:4567-4789)
+# ---------------------------------------------------------------------------
+
+
+class TestRewrites:
+    def test_arange_reshape_values(self):
+        a = rt.arange(24).reshape(4, 6) + 0
+        np.testing.assert_array_equal(a.asarray(),
+                                      np.arange(24).reshape(4, 6))
+
+    def test_arange_reshape_rewrites_to_fill(self):
+        a = rt.arange(24, dtype=np.float64)
+        r = Node("reshape", ((4, 6),), [a.read_expr()])
+        (out,) = rewrite_roots([r])
+        assert out.op == "fromfunction"
+        rt.sync()
+
+    def test_stack_mean_advindex_values(self):
+        # the xarray groupby().mean() expansion (docs/index.md:53-58)
+        x = np.arange(48, dtype=np.float64).reshape(4, 12)
+        labels = np.arange(12) % 3
+        X = rt.fromarray(x)
+        cols = [np.where(labels == g)[0] for g in range(3)]
+        stacked = rt.stack([rt.mean(X[:, idx], axis=1) for idx in cols],
+                           axis=1)
+        expect = np.stack([x[:, idx].mean(axis=1) for idx in cols], axis=1)
+        np.testing.assert_allclose(stacked.asarray(), expect)
+
+    def test_stack_mean_advindex_rewrites_to_segment_reduce(self):
+        x = np.arange(48, dtype=np.float64).reshape(4, 12)
+        labels = np.arange(12) % 3
+        X = rt.fromarray(x)
+        cols = [np.where(labels == g)[0] for g in range(3)]
+        stacked = rt.stack([rt.mean(X[:, idx], axis=1) for idx in cols],
+                           axis=1)
+        (out,) = rewrite_roots([stacked.read_expr()])
+        ops = _collect_ops(out)
+        assert "segment_reduce" in ops
+        assert "stack" not in ops
+        rt.sync()
+
+    def test_concat_binop_getitem_values(self):
+        # the xarray anomaly pattern: x[:, idx_g] - m[g], concatenated
+        x = np.arange(60, dtype=np.float64).reshape(5, 12)
+        labels = np.arange(12) % 3
+        m = np.stack([x[:, labels == g].mean(axis=1) for g in range(3)], 0)
+        X, M = rt.fromarray(x), rt.fromarray(m)
+        cols = [np.where(labels == g)[0] for g in range(3)]
+        parts = [X[:, idx] - M[g].reshape(5, 1) for g, idx in enumerate(cols)]
+        # build without the reshape broadcast (keep pattern exact):
+        parts = [X[:, idx] - M[g][:, None] for g, idx in enumerate(cols)]
+        out = rt.concatenate(parts, axis=1)
+        expect = np.concatenate(
+            [x[:, idx] - m[g][:, None] for g, idx in enumerate(cols)], axis=1
+        )
+        np.testing.assert_allclose(out.asarray(), expect)
+
+    def test_rewrite_disabled_flag(self, monkeypatch):
+        from ramba_tpu import common
+
+        monkeypatch.setattr(common, "rewrite_enabled", False)
+        a = rt.arange(24).reshape(4, 6) + 0
+        np.testing.assert_array_equal(a.asarray(),
+                                      np.arange(24).reshape(4, 6))
+
+
+def _collect_ops(root):
+    ops = []
+    stack = [root]
+    seen = set()
+    while stack:
+        e = stack.pop()
+        if id(e) in seen:
+            continue
+        seen.add(id(e))
+        if isinstance(e, Node):
+            ops.append(e.op)
+            stack.extend(e.args)
+    return ops
+
+
+# ---------------------------------------------------------------------------
+# constraints (reference: ramba.py:5296-5315,9915-9922)
+# ---------------------------------------------------------------------------
+
+
+class TestConstraints:
+    def test_smap_axis_constraint(self):
+        from ramba_tpu.parallel import constraints
+
+        constraints.clear_constraints()
+        a = rt.arange(1024).astype(np.float64)
+        b = rt.ones(1024)
+        out = rt.smap(lambda x, y: x + y, a, b, axis=0)
+        assert len(constraints.get_constraints()) == 1
+        np.testing.assert_allclose(out.asarray(),
+                                   np.arange(1024) + 1.0)
+
+    def test_add_constraint_2d(self):
+        from ramba_tpu.parallel import constraints
+
+        constraints.clear_constraints()
+        a = rt.fromarray(np.arange(64, dtype=np.float64).reshape(8, 8))
+        b = rt.fromarray(np.ones((8, 8)))
+        con = rt.add_constraint([a, b], axis=1)
+        assert con.axis == 1
+        np.testing.assert_allclose((a * b).asarray(),
+                                   np.arange(64).reshape(8, 8))
+
+
+# ---------------------------------------------------------------------------
+# jit / remote (reference: ramba.py:549-874)
+# ---------------------------------------------------------------------------
+
+
+class TestJitRemote:
+    def test_jit_on_ndarray(self):
+        @rt.jit
+        def f(x, y):
+            return x * 2 + y
+
+        a = rt.arange(100).astype(np.float64)
+        out = f(a, 3.0)
+        assert isinstance(out, rt.ndarray)
+        np.testing.assert_allclose(out.asarray(), np.arange(100) * 2 + 3)
+
+    def test_jit_plain_args(self):
+        @rt.jit
+        def f(x):
+            return x + 1
+
+        assert int(f(np.int64(1))) == 2
+
+    def test_remote_function(self):
+        @rt.remote
+        def work(x):
+            return x * x
+
+        fut = work.remote(7)
+        assert rt.get(fut) == 49
+        assert work(3) == 9
+
+    def test_remote_class(self):
+        @rt.remote
+        class Counter:
+            def __init__(self, start):
+                self.n = start
+
+            def incr(self, k):
+                self.n += k
+                return self.n
+
+        c = Counter.remote(10)
+        assert rt.get(c.incr.remote(5)) == 15
+        assert rt.get([c.incr.remote(1), c.incr.remote(1)]) == [16, 17]
+
+
+# ---------------------------------------------------------------------------
+# distributed bring-up (reference: common.py:49-100, ramba.py:10650-10724)
+# ---------------------------------------------------------------------------
+
+
+class TestDistributed:
+    def test_in_driver_single_host(self):
+        assert rt.distributed.in_driver()
+        assert rt.distributed.process_count() == 1
+        assert rt.distributed.process_index() == 0
+
+    def test_initialize_noop_without_coordinator(self):
+        rt.distributed.initialize()  # must not raise on single host
+
+    def test_global_mesh(self):
+        m = rt.distributed.global_mesh()
+        assert m.devices.size == 8
+
+    def test_local_devices(self):
+        assert len(rt.distributed.local_devices()) == 8
