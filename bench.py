@@ -50,6 +50,39 @@ def main():
         walls.append(w)
     wall = min(walls)
 
+    # Secondary metric: PRK star stencil r=2 (BASELINE.md table; reference
+    # Ramba: 49748 MFlops on a 36-core node).  Chained iterations amortize
+    # the dispatch tunnel latency; flops convention matches the PRK kernel
+    # (13 flops per interior point).
+    import numpy as np
+
+    import ramba_tpu as rt2
+
+    @rt2.stencil
+    def star2(a):
+        return (
+            0.25 * (a[0, 1] + a[0, -1] + a[1, 0] + a[-1, 0])
+            + 0.125 * (a[0, 2] + a[0, -2] + a[2, 0] + a[-2, 0])
+        )
+
+    sn = 8192 if platform != "cpu" else 512
+    sk = 30 if platform != "cpu" else 3
+    x = rt2.fromarray(np.random.RandomState(0).rand(sn, sn).astype(np.float32))
+    rt2.sync()
+
+    def stencil_chain():
+        y = x
+        for _ in range(sk):
+            y = rt2.sstencil(star2, y)
+        s = rt2.sum(y)
+        t0 = time.perf_counter()
+        float(s)
+        return time.perf_counter() - t0
+
+    stencil_chain()  # compile
+    st_iter = min(stencil_chain() for _ in range(2)) / sk
+    stencil_mflops = 13 * (sn - 4) * (sn - 4) / st_iter / 1e6
+
     # Materialized roots: A, B, C, D (4·n·itemsize written) + reduce read.
     gbytes = 4 * n * itemsize / 1e9
     baseline_numpy_s = 47.56  # /root/reference/README.md:31-36
@@ -66,6 +99,8 @@ def main():
                 "n": n,
                 "platform": platform,
                 "checksum": sval,
+                "stencil_mflops": round(stencil_mflops),
+                "stencil_vs_ramba_1node": round(stencil_mflops / 49748, 2),
             }
         )
     )

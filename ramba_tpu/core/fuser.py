@@ -296,7 +296,8 @@ def flush(extra: Sequence[Expr] = ()) -> list:
         _timing.add_time("trace_compile_first_call", dt)
     else:
         _timing.add_time("flush_execute", dt)
-        _timing.add_func_time(_program_label(program), dt)
+        if common.timing_level > 0:  # label hashing is off the hot path
+            _timing.add_func_time(_program_label(program), dt)
     del leaf_vals
     for arr, val in zip(roots, outs[: len(roots)]):
         arr._set_expr(Const(val))

@@ -150,11 +150,15 @@ def rewrite_stack_reduce_advindex(node: Node):
         elif b is not base or d != dim or k != kind:
             return None
         groups.append(np.asarray(idx.value))
-    # full, disjoint coverage of the grouped dimension
+    # full, disjoint coverage of the grouped dimension; duplicates inside a
+    # single group would collapse under segment_reduce (the original sums
+    # the element once per occurrence), so reject them too
     n = base.aval.shape[dim]
+    if sum(len(g) for g in groups) != n:
+        return None
     labels = np.full((n,), -1, np.int64)
     for g, idx in enumerate(groups):
-        if idx.ndim != 1:
+        if idx.ndim != 1 or np.unique(idx).size != idx.size:
             return None
         if np.any(labels[idx] != -1):
             return None
@@ -175,7 +179,18 @@ def rewrite_stack_reduce_advindex(node: Node):
 def rewrite_concat_binop_getitem(node: Node):
     """concatenate([binop(x[..., idx_g, ...], m[g]) for g]) ->
     binop(gather(x, cat(idx)), gather(m, group_of_position))
-    (reference: rewrite_concatenate_binop_getitem, ramba.py:4680-4789)."""
+    (reference: rewrite_concatenate_binop_getitem, ramba.py:4680-4789).
+
+    Two per-group operand forms are recognized:
+
+    * plain ``m[g]`` — accepted only when trailing-alignment broadcasting
+      places the gathered group axis exactly on the concat axis
+      (x.ndim - dim == m.ndim - m_dim, and every m axis left of the group
+      axis has size 1); anything else broadcasts differently before and
+      after the rewrite, so it is left alone.
+    * ``m[g][:, None]`` with 2-D x and m, groups on x axis 1 — the xarray
+      climatology/anomaly idiom; lowered to take + transpose.
+    """
     if node.op != "concatenate" or len(node.args) < 2:
         return None
     (axis,) = node.static
@@ -184,6 +199,8 @@ def rewrite_concat_binop_getitem(node: Node):
     fname = None
     m_base = None
     swapped = None
+    m_dim = None
+    newaxis_form = None
     groups = []
     for gi, a in enumerate(node.args):
         if not (isinstance(a, Node) and a.op == "map" and len(a.args) == 2):
@@ -199,26 +216,43 @@ def rewrite_concat_binop_getitem(node: Node):
         else:
             return None
         b, d, idx = gather
-        # other must be m[g]: a getitem selecting integer g on one dim
-        sel = _int_select(other, gi)
+        # other must be m[g] (optionally followed by one trailing newaxis)
+        sel = _int_select_chain(other, gi)
         if sel is None:
             return None
-        mb, mdim = sel
+        mb, mdim, nform = sel
         if base is None:
-            base, dim, fname, m_base, swapped, m_dim = b, d, f, mb, sw, mdim
+            base, dim, fname, m_base, swapped, m_dim, newaxis_form = (
+                b, d, f, mb, sw, mdim, nform
+            )
         elif (b is not base or d != dim or f != fname or mb is not m_base
-              or sw != swapped or mdim != m_dim):
+              or sw != swapped or mdim != m_dim or nform != newaxis_form):
             return None
         groups.append(np.asarray(idx.value))
     if axis != dim:
         return None
+    x_ndim = base.aval.ndim
+    m_shape = tuple(m_base.aval.shape)
+    if newaxis_form:
+        # m[g][:, None]: supported shape pattern is 2-D x grouped on axis 1
+        # with m laid out (groups, x_rows)
+        if not (x_ndim == 2 and dim == 1 and len(m_shape) == 2
+                and m_dim == 0):
+            return None
+    else:
+        # plain m[g]: gathered group axis must land on the concat axis
+        # under numpy trailing alignment, with no real axes left of it
+        if len(m_shape) - m_dim != x_ndim - dim:
+            return None
+        if any(s != 1 for s in m_shape[:m_dim]):
+            return None
     cat_idx = np.concatenate(groups)
     pos_group = np.concatenate(
         [np.full((len(g),), gi, np.int32) for gi, g in enumerate(groups)]
     )
-    ndim = base.aval.ndim
     enc = tuple(
-        ("i", 0) if q == dim else ("s", None, None, None) for q in range(ndim)
+        ("i", 0) if q == dim else ("s", None, None, None)
+        for q in range(x_ndim)
     )
     gathered_x = Node(
         "getitem_adv", (enc, (dim,)),
@@ -227,6 +261,9 @@ def rewrite_concat_binop_getitem(node: Node):
     gathered_m = Node(
         "take", (m_dim, "clip"), [m_base, Const(_to_device(pos_group))]
     )
+    if newaxis_form:
+        # (n_positions, x_rows) -> (x_rows, n_positions) to align with x
+        gathered_m = Node("permute", ((1, 0),), [gathered_m])
     args = [gathered_m, gathered_x] if swapped else [gathered_x, gathered_m]
     return Node("map", (fname,), args)
 
@@ -252,6 +289,27 @@ def _int_select(e: Expr, expect: int):
     if dim is None:
         return None
     return e.args[0], dim
+
+
+def _int_select_chain(e: Expr, expect: int):
+    """Match ``m[g]`` or ``m[g][:, None]``.  Returns
+    (m_base, group_dim, has_trailing_newaxis) or None."""
+    sel = _int_select(e, expect)
+    if sel is not None:
+        return sel[0], sel[1], False
+    # one wrapping getitem of full slices + a single trailing newaxis
+    if not (isinstance(e, Node) and e.op == "getitem"):
+        return None
+    (enc,) = e.static
+    if len(enc) < 1 or enc[-1] != ("n",):
+        return None
+    if any(part[0] != "s" or part[1:] != (None, None, None)
+           for part in enc[:-1]):
+        return None
+    inner = _int_select(e.args[0], expect)
+    if inner is None:
+        return None
+    return inner[0], inner[1], True
 
 
 def _to_device(x: np.ndarray):

@@ -1,0 +1,180 @@
+"""Pallas TPU kernel for 2-D stencils.
+
+The reference's stencil path (/root/reference/ramba/ramba.py:3315-3376)
+compiles a ``numba.stencil`` per worker and runs it over halo-padded shards —
+its PRK star-stencil benchmark hits ~50 GFlops/node (README.md:281-299).
+The rebuild's default path lowers stencils to shifted-slice arithmetic that
+XLA fuses (skeletons._eval_stencil); this module adds a hand-tiled Pallas
+kernel for the hot case: 2-D float stencils on a single TPU chip.
+
+Design (pallas_guide.md patterns):
+
+* The input is zero-padded by the stencil halo and the lane dimension is
+  rounded up to 128.  The kernel grid walks row slabs; each instance DMAs
+  its slab (rows + halo) from HBM into a VMEM scratch buffer, then evaluates
+  the user's kernel function over *statically shifted* in-VMEM slices — the
+  same trace-the-user-function approach as the XLA path, so arbitrary
+  (including nonlinear) stencil bodies work.
+* Output blocks are plain VMEM BlockSpecs; borders are zeroed afterwards to
+  match sstencil's semantics (the reference writes only indices whose full
+  neighborhood is in range).
+
+Multi-chip stencils stay on the GSPMD path (XLA inserts the halo
+collective-permutes); fusing this kernel into a shard_map with explicit
+ppermute halos is the planned next step.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_INTERPRET = os.environ.get("RAMBA_TPU_PALLAS_INTERPRET", "0") not in ("0", "")
+_ENABLED = os.environ.get("RAMBA_TPU_PALLAS", "1") not in ("0", "")
+
+# VMEM working-set budget for slabs + output block (bytes); a v5e core has
+# ~16 MB of VMEM and the runtime needs headroom for double-buffered output.
+_VMEM_BUDGET = 8 << 20
+
+
+def available(arrs) -> bool:
+    """Pallas path eligibility for this op instance."""
+    if not _ENABLED:
+        return False
+    if not (_INTERPRET or jax.default_backend() == "tpu"):
+        return False
+    if len(jax.devices()) != 1 and not _INTERPRET:
+        # sharded inputs would be all-gathered around the pallas_call;
+        # keep GSPMD's halo exchange instead
+        return False
+    shapes = {a.shape for a in arrs}
+    if len(shapes) != 1:
+        return False
+    (shape,) = shapes
+    if len(shape) != 2:
+        return False
+    # one uniform dtype: scratch slabs are allocated with a single dtype
+    dtypes = {a.dtype for a in arrs}
+    return len(dtypes) == 1 and dtypes <= {jnp.dtype(jnp.float32),
+                                           jnp.dtype(jnp.bfloat16)}
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def run(func, lo, hi, slots, arrs, taps=8):
+    """Evaluate the stencil with a Pallas kernel.  Returns the full-shape
+    result with border cells zeroed (sstencil semantics)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    x = arrs[0]
+    H, W = x.shape
+    dtype = x.dtype
+    top, left = -lo[0], -lo[1]
+    bottom, right = hi[0], hi[1]
+    halo_r = top + bottom
+
+    Wo = _round_up(max(W, 128), 128)
+    Wi = _round_up(Wo + left + right, 128)
+
+    # Rows per output block within the VMEM budget.  Mosaic materializes
+    # one (bh, Wo) temporary per shifted-slice read on its VMEM stack, so
+    # the working set is ~ (taps + double-buffered out) output-width blocks
+    # plus the input slabs.
+    itemsize = np.dtype(dtype).itemsize
+    n_slabs = len(arrs)
+    denom = itemsize * (n_slabs * Wi + (max(taps, 1) + 3) * Wo)
+    bh = max(8, min(512, (_VMEM_BUDGET // denom - halo_r) // 8 * 8))
+    grid = -(-H // bh)
+    Ho = grid * bh
+
+    # Mosaic requires HBM slices 8-aligned in the sublane dim: round the
+    # slab height up and pad the input tail to cover the extra rows read.
+    slab_h = _round_up(bh + halo_r, 8)
+    extra = slab_h - (bh + halo_r)
+
+    def pad(a):
+        return jnp.pad(
+            a, ((top, Ho - H + bottom + extra), (left, Wi - W - left)),
+        )
+
+    padded = [pad(a) for a in arrs]
+
+    def make_kernel(wrap):
+        return lambda *refs: _kernel_body(wrap, *refs)
+
+    def _kernel_body(wrap, *refs):
+        # refs: n_slabs HBM inputs, out_ref, n_slabs VMEM scratch, 1 sem
+        ins = refs[:n_slabs]
+        out_ref = refs[n_slabs]
+        slabs = refs[n_slabs + 1: 2 * n_slabs + 1]
+        sem = refs[-1]
+        i = pl.program_id(0)
+        for k in range(n_slabs):
+            cp = pltpu.make_async_copy(
+                ins[k].at[pl.ds(i * bh, slab_h), :], slabs[k], sem
+            )
+            cp.start()
+            cp.wait()
+
+        from ramba_tpu.skeletons import _KVal, _unwrap
+
+        class _Shift:
+            def __init__(self, ref, wrap_vals):
+                self.ref = ref
+                self.wrap_vals = wrap_vals
+
+            def __getitem__(self, off):
+                if not isinstance(off, tuple):
+                    off = (off,)
+                di, dj = off
+                piece = self.ref[
+                    top + di: top + di + bh, left + dj: left + dj + Wo
+                ]
+                return _KVal(piece) if self.wrap_vals else piece
+
+        call_args = []
+        ai = 0
+        for kind, payload in slots:
+            if kind == "arr":
+                call_args.append(_Shift(slabs[ai], wrap))
+                ai += 1
+            else:
+                call_args.append(payload.v)
+        val = _unwrap(func(*call_args)).astype(dtype)
+        # zero the stencil border in-kernel (cells whose neighborhood
+        # leaves the valid array) — saves a full masking pass afterwards
+        gr = jax.lax.broadcasted_iota(jnp.int32, (bh, Wo), 0) + i * bh
+        gc = jax.lax.broadcasted_iota(jnp.int32, (bh, Wo), 1)
+        valid = (gr >= top) & (gr < H - bottom) & (gc >= left) & (gc < W - right)
+        out_ref[:] = jnp.where(valid, val, jnp.zeros((), dtype))
+
+    def build(wrap):
+        # out_shape is the exact result shape: pallas clips partial edge
+        # blocks, and the kernel masks the stencil border itself, so no
+        # post-processing pass is needed.
+        return pl.pallas_call(
+            make_kernel(wrap),
+            grid=(grid,),
+            out_shape=jax.ShapeDtypeStruct((H, W), dtype),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * n_slabs,
+            out_specs=pl.BlockSpec((bh, Wo), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+            scratch_shapes=(
+                [pltpu.VMEM((slab_h, Wi), dtype)] * n_slabs
+                + [pltpu.SemaphoreType.DMA]
+            ),
+            interpret=_INTERPRET,
+        )(*padded)
+
+    try:
+        return build(False)
+    except (jax.errors.TracerArrayConversionError, TypeError):
+        # kernel body reached for NumPy, which can't consume tracers —
+        # retry with ufunc-rerouting proxies (cf. skeletons._call_kernel)
+        return build(True)

@@ -294,15 +294,22 @@ class _ProbeValue:
     its relative-offset access pattern (the reference probes with a local
     numba.stencil run, ramba.py:9989-10000)."""
 
-    def _op(self, *_):
+    def _op(self, *_, **__):
         return _ProbeValue()
 
     for _name in ["__add__", "__radd__", "__sub__", "__rsub__", "__mul__",
                   "__rmul__", "__truediv__", "__rtruediv__", "__pow__",
                   "__rpow__", "__neg__", "__floordiv__", "__rfloordiv__",
-                  "__mod__", "__rmod__", "__abs__"]:
+                  "__mod__", "__rmod__", "__abs__", "__lt__", "__le__",
+                  "__gt__", "__ge__", "__eq__", "__ne__", "__and__",
+                  "__or__", "__xor__", "__invert__"]:
         locals()[_name] = _op
     del _name
+    __hash__ = object.__hash__
+
+    # numpy ufuncs on probe values (e.g. np.maximum(p, q)) absorb too
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        return _ProbeValue()
 
 
 class _ProbeProxy:
@@ -379,7 +386,8 @@ class StencilKernel:
                        for d in range(nd))
             hi = tuple(max(0, *(o[d] for o in all_offs)) if all_offs else 0
                        for d in range(nd))
-            self._probe_cache = (lo, hi)
+            # tap count steers the pallas kernel's VMEM block budget
+            self._probe_cache = (lo, hi, len(all_offs))
             self._probe_key = cache_key
         return self._probe_cache
 
@@ -394,9 +402,9 @@ class StencilKernel:
                 operands.append(jnp.asarray(a))
             else:
                 slots.append(("lit", _Lit(a)))
-        lo, hi = self.neighborhood(tuple(slots))
+        lo, hi, taps = self.neighborhood(tuple(slots))
         return np.asarray(
-            _eval_stencil((self.func, lo, hi, tuple(slots)), *operands)
+            _eval_stencil((self.func, lo, hi, tuple(slots), taps), *operands)
         )
 
 
@@ -408,7 +416,15 @@ def stencil(func=None, **kwargs):
 
 
 def _eval_stencil(static, *arrs):
-    func, lo, hi, slots = static
+    func, lo, hi, slots, taps = static
+    if len(arrs[0].shape) == 2:
+        from ramba_tpu.ops import stencil_pallas
+
+        if stencil_pallas.available(arrs):
+            try:
+                return stencil_pallas.run(func, lo, hi, slots, arrs, taps)
+            except Exception:
+                pass  # any pallas limitation falls back to the XLA path
     shape = arrs[0].shape
     interior = tuple(
         s - (h - l) for s, l, h in zip(shape, lo, hi)
@@ -448,12 +464,14 @@ def sstencil(st, arr, *args):
         asarray(a) if isinstance(a, (np.ndarray, list)) else a for a in args
     ]
     slots, operands = _split_operands(tuple(full_args))
-    lo, hi = st.neighborhood(tuple(slots))
+    lo, hi, taps = st.neighborhood(tuple(slots))
     if len(lo) != arr.ndim:
         raise ValueError(
             f"stencil kernel indexes {len(lo)} dims but array has {arr.ndim}"
         )
-    return ndarray(Node("stencil", (st.func, lo, hi, tuple(slots)), operands))
+    return ndarray(
+        Node("stencil", (st.func, lo, hi, tuple(slots), taps), operands)
+    )
 
 
 # ---------------------------------------------------------------------------

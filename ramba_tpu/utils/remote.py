@@ -100,13 +100,20 @@ class _RemoteFunction:
 class _RemoteActorHandle:
     def __init__(self, cls, args, kwargs):
         self._obj = cls(*args, **kwargs)
+        # Ray actors execute one method at a time; a dedicated single
+        # worker preserves that serialization (and submission order) so
+        # actor state is never raced.
+        self._executor = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="ramba_tpu_actor"
+        )
 
     def __getattr__(self, name):
         method = getattr(self._obj, name)
+        executor = self._executor
 
         class _M:
             def remote(_self, *a, **kw):
-                return _get_pool().submit(method, *a, **kw)
+                return executor.submit(method, *a, **kw)
 
         return _M()
 

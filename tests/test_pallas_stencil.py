@@ -1,0 +1,90 @@
+"""Pallas stencil kernel, run in interpreter mode on the CPU mesh (the
+real-TPU lowering of the same kernel is exercised by bench.py on hardware).
+"""
+
+import numpy as np
+import pytest
+
+import ramba_tpu as rt
+from ramba_tpu.ops import stencil_pallas
+
+
+@pytest.fixture
+def interpret_mode(monkeypatch):
+    monkeypatch.setattr(stencil_pallas, "_INTERPRET", True)
+    monkeypatch.setattr(stencil_pallas, "_ENABLED", True)
+
+
+def _prk_star2(w=None):
+    @rt.stencil
+    def star2(a):
+        return (
+            0.25 * (a[0, 1] + a[0, -1] + a[1, 0] + a[-1, 0])
+            + 0.125 * (a[0, 2] + a[0, -2] + a[2, 0] + a[-2, 0])
+        )
+
+    return star2
+
+
+def _star2_numpy(x):
+    out = np.zeros_like(x)
+    out[2:-2, 2:-2] = (
+        0.25 * (x[2:-2, 3:-1] + x[2:-2, 1:-3] + x[3:-1, 2:-2] + x[1:-3, 2:-2])
+        + 0.125 * (x[2:-2, 4:] + x[2:-2, :-4] + x[4:, 2:-2] + x[:-4, 2:-2])
+    )
+    return out
+
+
+class TestPallasStencil:
+    def test_star2_matches_numpy(self, interpret_mode):
+        x = np.arange(40 * 36, dtype=np.float32).reshape(40, 36) / 7.0
+        out = rt.sstencil(_prk_star2(), rt.fromarray(x)).asarray()
+        np.testing.assert_allclose(out, _star2_numpy(x), rtol=1e-5)
+
+    def test_available_gating(self):
+        import jax
+        import jax.numpy as jnp
+
+        a = jnp.zeros((16, 16), jnp.float32)
+        # CPU without interpret mode: not available
+        assert not stencil_pallas.available([a])
+
+    def test_odd_sizes(self, interpret_mode):
+        # non-multiple-of-128 width, non-multiple-of-block height
+        x = np.random.RandomState(0).rand(37, 131).astype(np.float32)
+        out = rt.sstencil(_prk_star2(), rt.fromarray(x)).asarray()
+        np.testing.assert_allclose(out, _star2_numpy(x), rtol=1e-4, atol=1e-5)
+
+    def test_asymmetric_offsets(self, interpret_mode):
+        @rt.stencil
+        def shifted(a):
+            return a[-1, 0] + a[0, 2]
+
+        x = np.arange(64, dtype=np.float32).reshape(8, 8)
+        out = rt.sstencil(shifted, rt.fromarray(x)).asarray()
+        e = np.zeros_like(x)
+        e[1:, :-2] = x[:-1, :-2] + x[1:, 2:]
+        np.testing.assert_allclose(out, e)
+
+    def test_two_input_arrays(self, interpret_mode):
+        @rt.stencil
+        def mix(a, b):
+            return a[0, 0] + 0.5 * (b[-1, 0] + b[1, 0])
+
+        x = np.random.RandomState(1).rand(24, 40).astype(np.float32)
+        y = np.random.RandomState(2).rand(24, 40).astype(np.float32)
+        out = rt.sstencil(mix, rt.fromarray(x), rt.fromarray(y)).asarray()
+        e = np.zeros_like(x)
+        e[1:-1, :] = x[1:-1, :] + 0.5 * (y[:-2, :] + y[2:, :])
+        np.testing.assert_allclose(out, e, rtol=1e-6)
+
+    def test_numpy_kernel_body(self, interpret_mode):
+        @rt.stencil
+        def npk(a):
+            return np.maximum(a[0, -1], a[0, 1])
+
+        x = np.random.RandomState(3).rand(16, 20).astype(np.float32)
+        out = rt.sstencil(npk, rt.fromarray(x)).asarray()
+        e = np.zeros_like(x)
+        e[:, 1:-1] = np.maximum(x[:, :-2], x[:, 2:])
+        np.testing.assert_allclose(out, e)

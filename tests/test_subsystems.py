@@ -30,9 +30,11 @@ class TestTiming:
         assert snap["timers"]["unit_test"] == (2.0, 2)
         assert snap["sub_timers"][("unit_test", "sub")] == (0.25, 1)
 
-    def test_flush_records_exec_and_per_func(self):
+    def test_flush_records_exec_and_per_func(self, monkeypatch):
+        from ramba_tpu import common
         from ramba_tpu.utils import timing
 
+        monkeypatch.setattr(common, "timing_level", 1)  # per_func is gated
         timing.reset()
         for _ in range(2):  # 2nd run is a guaranteed compile-cache hit
             a = rt.arange(1000) * 2.0
@@ -143,12 +145,69 @@ class TestRewrites:
         m = np.stack([x[:, labels == g].mean(axis=1) for g in range(3)], 0)
         X, M = rt.fromarray(x), rt.fromarray(m)
         cols = [np.where(labels == g)[0] for g in range(3)]
-        parts = [X[:, idx] - M[g].reshape(5, 1) for g, idx in enumerate(cols)]
-        # build without the reshape broadcast (keep pattern exact):
         parts = [X[:, idx] - M[g][:, None] for g, idx in enumerate(cols)]
         out = rt.concatenate(parts, axis=1)
         expect = np.concatenate(
             [x[:, idx] - m[g][:, None] for g, idx in enumerate(cols)], axis=1
+        )
+        np.testing.assert_allclose(out.asarray(), expect)
+
+    def test_concat_binop_newaxis_rewrites(self):
+        # the [:, None] climatology idiom must fire the rewrite
+        x = np.arange(60, dtype=np.float64).reshape(5, 12)
+        labels = np.arange(12) % 3
+        m = np.stack([x[:, labels == g].mean(axis=1) for g in range(3)], 0)
+        X, M = rt.fromarray(x), rt.fromarray(m)
+        cols = [np.where(labels == g)[0] for g in range(3)]
+        parts = [X[:, idx] - M[g][:, None] for g, idx in enumerate(cols)]
+        out = rt.concatenate(parts, axis=1)
+        (r,) = rewrite_roots([out.read_expr()])
+        ops = _collect_ops(r)
+        assert "concatenate" not in ops
+        assert "take" in ops
+        rt.sync()
+
+    def test_stack_reduce_duplicate_in_group_no_rewrite(self):
+        # duplicates within one group: original counts twice, segment_reduce
+        # would count once -> the rewrite must not fire, values must match
+        x = np.arange(24, dtype=np.float64).reshape(4, 6)
+        X = rt.fromarray(x)
+        groups = [np.array([0, 0, 1]), np.array([2, 3, 4, 5])]
+        stacked = rt.stack([rt.sum(X[:, i], axis=1) for i in groups], axis=1)
+        (r,) = rewrite_roots([stacked.read_expr()])
+        assert "segment_reduce" not in _collect_ops(r)
+        expect = np.stack([x[:, i].sum(axis=1) for i in groups], axis=1)
+        np.testing.assert_allclose(stacked.asarray(), expect)
+
+    def test_concat_binop_misaligned_no_rewrite(self):
+        # 1-D-per-group operand against rows grouped on axis 0: trailing
+        # broadcast alignment differs before/after -> must not fire
+        x = np.arange(60, dtype=np.float64).reshape(12, 5)
+        labels = np.arange(12) % 3
+        m = np.array([10.0, 20.0, 30.0])
+        X, M = rt.fromarray(x), rt.fromarray(m)
+        rows = [np.where(labels == g)[0] for g in range(3)]
+        parts = [X[idx] - M[g] for g, idx in enumerate(rows)]
+        out = rt.concatenate(parts, axis=0)
+        expect = np.concatenate(
+            [x[idx] - m[g] for g, idx in enumerate(rows)], axis=0
+        )
+        np.testing.assert_allclose(out.asarray(), expect)
+
+    def test_concat_binop_scalar_groups_rewrites(self):
+        # 1-D x grouped on axis 0 with scalar-per-group operand: aligned,
+        # fires and stays correct
+        x = np.arange(12, dtype=np.float64)
+        labels = np.arange(12) % 3
+        m = np.array([10.0, 20.0, 30.0])
+        X, M = rt.fromarray(x), rt.fromarray(m)
+        pos = [np.where(labels == g)[0] for g in range(3)]
+        parts = [X[idx] * M[g] for g, idx in enumerate(pos)]
+        out = rt.concatenate(parts, axis=0)
+        (r,) = rewrite_roots([out.read_expr()])
+        assert "concatenate" not in _collect_ops(r)
+        expect = np.concatenate(
+            [x[idx] * m[g] for g, idx in enumerate(pos)]
         )
         np.testing.assert_allclose(out.asarray(), expect)
 
