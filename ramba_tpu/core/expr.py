@@ -91,13 +91,49 @@ def as_expr(x) -> Expr:
     raise TypeError(f"cannot lift {type(x)} into an expression")
 
 
+_aval_memo: dict = {}
+
+_MEMO_SAFE_TYPES = (str, bytes, int, float, complex, bool, type(None), np.dtype)
+
+
+def _value_hashable(x) -> bool:
+    """True if ``x`` hashes by value (safe as a memo key component)."""
+    if isinstance(x, _MEMO_SAFE_TYPES) or isinstance(x, (np.generic,)):
+        return True
+    if isinstance(x, (tuple, frozenset)):
+        return all(_value_hashable(e) for e in x)
+    return False
+
+
 def infer_aval(op: str, static: tuple, arg_avals: list):
     """Shape/dtype inference by abstract evaluation of the op's own eval rule —
     guarantees inference always matches execution (the reference instead
     duplicates shape/dtype logic in every ``DAGshape``-returning API function,
-    ramba.py:5133-5165)."""
+    ramba.py:5133-5165).  Memoized: eval_shape costs ~1 ms, which would
+    otherwise dominate graph-build time for op-chain workloads."""
     fn = OPS[op]
-    return jax.eval_shape(lambda *a: fn(static, *a), *arg_avals)
+    try:
+        key = (op, static, tuple(
+            (tuple(a.shape), str(a.dtype), bool(getattr(a, "weak_type", False)))
+            for a in arg_avals
+        ))
+        hash(key)
+        if not _value_hashable(static):
+            # identity-hashed statics (closures, array literals) can never
+            # hit, and each miss would pin the object in the memo
+            key = None
+    except TypeError:
+        key = None
+    if key is not None:
+        hit = _aval_memo.get(key)
+        if hit is not None:
+            return hit
+    out = jax.eval_shape(lambda *a: fn(static, *a), *arg_avals)
+    if key is not None:
+        if len(_aval_memo) > 8192:
+            _aval_memo.clear()
+        _aval_memo[key] = out
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -156,6 +192,32 @@ MAPFN["matmul_elem"] = jnp.multiply  # placeholder slot
 def _op_map(static, *args):
     (fname,) = static
     return MAPFN[fname](*args)
+
+
+def make_map(fname: str, operands: Sequence[Expr]) -> Expr:
+    """Build an elementwise map node, strength-reducing ``power`` by a small
+    static integer exponent into a multiply chain.
+
+    Scalar operands are normally runtime arguments (to keep the compile cache
+    value-independent), but a runtime exponent forces stablehlo.power — the
+    exp/log path on the TPU VPU — where a literal ``x**2`` would compile to one
+    multiply.  The reference has the same class of peephole in its codegen
+    (division rewritten to multiply-by-reciprocal, ramba.py:6121-6126)."""
+    if fname == "power" and len(operands) == 2:
+        e = operands[1]
+        if (
+            isinstance(e, Scalar)
+            and isinstance(e.value, (int, np.integer))
+            and not isinstance(e.value, (bool, np.bool_))
+            and 1 <= int(e.value) <= 4
+            and operands[0].dtype != np.bool_  # bool ** int promotes to int8
+        ):
+            x = operands[0]
+            out = x
+            for _ in range(int(e.value) - 1):
+                out = Node("map", ("multiply",), [out, x])
+            return out
+    return Node("map", (fname,), list(operands))
 
 
 @defop("cast")

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from ramba_tpu.core.expr import Node
+from ramba_tpu.core.expr import Node, make_map
 from ramba_tpu.core.ndarray import ViewOp, as_exprable, ndarray
 
 
@@ -43,13 +43,13 @@ class MaskedArray(ndarray):
         operands = [dense] + args
         if reverse:
             operands = operands[::-1]
-        val = Node("map", (fname,), operands)
+        val = make_map(fname, operands)
         guarded = Node("masked_fill", (), [dense, self._mask.read_expr(), val])
         return MaskedArray(ndarray(guarded), self._mask)
 
     def _inplace_map(self, fname, other):
         dense = self.read_expr()
-        val = Node("map", (fname,), [dense, as_exprable(other)])
+        val = make_map(fname, [dense, as_exprable(other)])
         if np.dtype(val.dtype) != self.dtype:
             val = Node("cast", (str(self.dtype),), [val])
         self._base.write_expr(

@@ -302,10 +302,10 @@ class ndarray:
         operands = [self.read_expr()] + args
         if reverse:
             operands = operands[::-1]
-        return ndarray(Node("map", (fname,), operands))
+        return ndarray(E.make_map(fname, operands))
 
     def _inplace_map(self, fname, other):
-        val = Node("map", (fname,), [self.read_expr(), as_exprable(other)])
+        val = E.make_map(fname, [self.read_expr(), as_exprable(other)])
         if np.dtype(val.dtype) != self.dtype:
             val = Node("cast", (str(self.dtype),), [val])
         self.write_expr(val)
@@ -488,7 +488,7 @@ class ndarray:
             if name not in E.MAPFN:
                 return NotImplemented
             operands = [as_exprable(x) for x in inputs]
-            res = ndarray(Node("map", (name,), operands))
+            res = ndarray(E.make_map(name, operands))
         elif method == "reduce":
             ufunc_red = {"add": "sum", "multiply": "prod", "minimum": "min",
                          "maximum": "max", "logical_and": "all",

@@ -17,7 +17,7 @@ from ramba_tpu.core.ndarray import ndarray, as_exprable
 
 
 def _map(fname, *operands):
-    return ndarray(Node("map", (fname,), [as_exprable(o) for o in operands]))
+    return ndarray(E.make_map(fname, [as_exprable(o) for o in operands]))
 
 
 def _make_unary(fname):

@@ -29,6 +29,7 @@ TPU-native design:
 
 from __future__ import annotations
 
+import warnings
 from typing import Callable
 
 import jax
@@ -415,6 +416,9 @@ def stencil(func=None, **kwargs):
     return StencilKernel(func)
 
 
+_pallas_fallback_warned = False
+
+
 def _eval_stencil(static, *arrs):
     func, lo, hi, slots, taps = static
     if len(arrs[0].shape) == 2:
@@ -423,8 +427,14 @@ def _eval_stencil(static, *arrs):
         if stencil_pallas.available(arrs):
             try:
                 return stencil_pallas.run(func, lo, hi, slots, arrs, taps)
-            except Exception:
-                pass  # any pallas limitation falls back to the XLA path
+            except Exception as e:  # fall back to the XLA path, but say so
+                global _pallas_fallback_warned
+                if not _pallas_fallback_warned:
+                    _pallas_fallback_warned = True
+                    warnings.warn(
+                        f"pallas stencil kernel unavailable, using XLA "
+                        f"shifted-slice path: {type(e).__name__}: {e}"
+                    )
     shape = arrs[0].shape
     interior = tuple(
         s - (h - l) for s, l, h in zip(shape, lo, hi)

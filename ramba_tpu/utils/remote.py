@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import functools
+import weakref
 from typing import Any
 
 import jax
@@ -106,6 +107,7 @@ class _RemoteActorHandle:
         self._executor = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="ramba_tpu_actor"
         )
+        weakref.finalize(self, self._executor.shutdown, wait=False)
 
     def __getattr__(self, name):
         method = getattr(self._obj, name)

@@ -351,6 +351,23 @@ class TestOps:
 
         run_both(f)
 
+    def test_pow_small_int_exponents(self):
+        # the strength-reduction peephole (make_map) must preserve numpy
+        # dtype/value semantics, including the bool**int -> int8 promotion
+        def f(app):
+            a = app.arange(11) - 5
+            x = app.arange(11) / 3.0
+            return a ** 2, a ** 3, a ** 4, x ** 1, x ** 2, x ** 5
+
+        run_both(f)
+        # bool base must NOT be strength-reduced to bool*bool: numpy
+        # promotes bool**int to an integer dtype (int8; jax picks int64 —
+        # the width differs but the kind must be integral)
+        b = rt.fromarray(np.array([True, False, True]))
+        assert (b ** 2).dtype.kind == "i"
+        np.testing.assert_array_equal((b ** 2).asarray(),
+                                      np.array([1, 0, 1]))
+
     def test_zero_d(self):
         def f(app):
             a = app.arange(10)
