@@ -18,11 +18,15 @@ import os
 import sys
 
 
+_FALSY = ("0", "", "false", "False", "FALSE", "no", "NO", "off", "OFF")
+_TRUTHY = ("1", "true", "True", "TRUE", "yes", "YES", "on", "ON")
+
+
 def _env_flag(name: str, default: bool = False) -> bool:
     v = os.environ.get(name, None)
     if v is None:
         return default
-    return v not in ("0", "", "false", "False", "no")
+    return v not in _FALSY
 
 
 def _env_int(name: str, default: int) -> int:
@@ -57,6 +61,32 @@ rewrite_enabled = _env_flag("RAMBA_TPU_REWRITE", True)
 
 # Forced number of devices ("workers"); default = all visible devices.
 num_workers_env = os.environ.get("RAMBA_WORKERS", None)
+
+# Persistent compiled-kernel cache across processes (reference: RAMBA_CACHE
+# activates a Numba disk cache under ~/.ramba_numba_cache keyed by source
+# hash, /root/reference/ramba/ramba.py:177-246).  Here the compiled artifacts
+# are XLA executables, persisted via jax's compilation cache.  Set
+# RAMBA_CACHE=1 for the default location or RAMBA_CACHE=/some/dir.
+cache_env = os.environ.get("RAMBA_CACHE", None)
+
+
+def setup_persistent_cache() -> str | None:
+    """Enable the on-disk XLA executable cache if RAMBA_CACHE is set.
+    Returns the cache directory (or None if disabled)."""
+    if not cache_env or cache_env in _FALSY:
+        return None
+    if cache_env in _TRUTHY:
+        path = os.path.expanduser("~/.ramba_tpu_xla_cache")
+    else:
+        path = os.path.expanduser(cache_env)
+    import jax
+
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # The reference caches every generated kernel regardless of compile time.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return path
 
 
 def dprint(level: int, *args) -> None:

@@ -690,10 +690,19 @@ def _install_operators():
             setattr(ndarray, name, meth)
 
     for red in _REDUCTIONS:
-        def rmeth(self, axis=None, keepdims=False, _f=red, dtype=None, out=None):
+        # NumPy method positional order is (axis, dtype, out); everything
+        # else keyword-only so a stray positional can't land in keepdims.
+        def rmeth(self, axis=None, dtype=None, out=None, *, keepdims=False,
+                  asarray=False, _f=red):
             r = self._reduce(_f, axis, keepdims)
             if dtype is not None:
                 r = r.astype(dtype)
+            if asarray:
+                # Keep the (deferred) result in array form — shape (1,) for a
+                # full reduction — so the caller can hold it without forcing a
+                # flush (reference: reduction asarray kwarg, used e.g. at
+                # ramba.py:6778 and sample pi integration).
+                r = r.reshape((1,) if r.ndim == 0 else r.shape)
             if out is not None:
                 out.write_expr(r.read_expr())
                 return out

@@ -16,39 +16,45 @@ from ramba_tpu.core.ndarray import ndarray, as_exprable
 from ramba_tpu.ops.creation import asarray
 
 
-def _red(name, a, axis=None, keepdims=False, dtype=None, out=None, ddof=None):
+def _red(name, a, axis=None, keepdims=False, dtype=None, out=None, ddof=None,
+         asarray_form=False):
     a = asarray(a)
     r = a._reduce(name, axis=axis, keepdims=keepdims, ddof=ddof)
     if dtype is not None:
         r = r.astype(dtype)
+    if asarray_form:
+        # `asarray=True` keeps a full reduction in deferred (1,)-array form
+        # (reference: reduction asarray kwarg, ramba.py:6778 / sample pi demo).
+        r = r.reshape((1,) if r.ndim == 0 else r.shape)
     if out is not None:
         out.write_expr(r.read_expr())
         return out
     return r
 
 
-def sum(a, axis=None, keepdims=False, dtype=None, out=None):  # noqa: A001
-    return _red("sum", a, axis, keepdims, dtype, out)
+def sum(a, axis=None, keepdims=False, dtype=None, out=None, *,  # noqa: A001
+        asarray=False):
+    return _red("sum", a, axis, keepdims, dtype, out, asarray_form=asarray)
 
 
-def prod(a, axis=None, keepdims=False, dtype=None, out=None):
-    return _red("prod", a, axis, keepdims, dtype, out)
+def prod(a, axis=None, keepdims=False, dtype=None, out=None, *, asarray=False):
+    return _red("prod", a, axis, keepdims, dtype, out, asarray_form=asarray)
 
 
-def min(a, axis=None, keepdims=False, out=None):  # noqa: A001
-    return _red("min", a, axis, keepdims, None, out)
+def min(a, axis=None, keepdims=False, out=None, *, asarray=False):  # noqa: A001
+    return _red("min", a, axis, keepdims, None, out, asarray_form=asarray)
 
 
-def max(a, axis=None, keepdims=False, out=None):  # noqa: A001
-    return _red("max", a, axis, keepdims, None, out)
+def max(a, axis=None, keepdims=False, out=None, *, asarray=False):  # noqa: A001
+    return _red("max", a, axis, keepdims, None, out, asarray_form=asarray)
 
 
 amin = min
 amax = max
 
 
-def mean(a, axis=None, keepdims=False, dtype=None, out=None):
-    return _red("mean", a, axis, keepdims, dtype, out)
+def mean(a, axis=None, keepdims=False, dtype=None, out=None, *, asarray=False):
+    return _red("mean", a, axis, keepdims, dtype, out, asarray_form=asarray)
 
 
 def var(a, axis=None, keepdims=False, ddof=0):
@@ -125,9 +131,51 @@ def cumprod(a, axis=None):
     return asarray(a).cumprod(axis)
 
 
-def average(a, axis=None, weights=None):
+def average(a, axis=None, weights=None, returned=False):
+    """NumPy-compatible weighted average, including the 1-D-weights-along-
+    ``axis`` broadcast rule (numpy.average semantics).  ``axis`` may be an
+    int, a tuple of ints, or None."""
+    import math
+
     a = asarray(a)
     if weights is None:
-        return a.mean(axis)
+        avg = a.mean(axis)
+        if returned:
+            if axis is None:
+                n = a.size
+            elif isinstance(axis, tuple):
+                n = math.prod(a.shape[ax % a.ndim] for ax in axis)
+            else:
+                n = a.shape[axis]
+            from ramba_tpu.ops.creation import full
+
+            return avg, full(avg.shape, float(n))
+        return avg
     w = asarray(weights)
-    return sum(a * w, axis=axis) / sum(w, axis=axis)
+    if w.shape != a.shape:
+        if axis is None:
+            raise TypeError(
+                "Axis must be specified when shapes of a and weights differ"
+            )
+        if not isinstance(axis, int):
+            raise TypeError(
+                "Axis must be an integer when 1D weights differ from a's shape"
+            )
+        if w.ndim != 1:
+            raise TypeError(
+                "1D weights expected when shapes of a and weights differ"
+            )
+        if w.shape[0] != a.shape[axis]:
+            raise ValueError(
+                "Length of weights not compatible with specified axis"
+            )
+        bshape = [1] * a.ndim
+        bshape[axis % a.ndim] = w.shape[0]
+        w = w.reshape(tuple(bshape))
+    scl = sum(w, axis=axis)
+    avg = sum(a * w, axis=axis) / scl
+    if returned:
+        if scl.shape != avg.shape:
+            scl = scl.broadcast_to(avg.shape)
+        return avg, scl
+    return avg

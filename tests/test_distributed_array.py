@@ -727,3 +727,106 @@ class TestReviewRegressions:
         assert not hasattr(rt, "np")
         assert not hasattr(rt, "Node")
         assert not hasattr(rt, "as_exprable")
+
+
+class TestApps:
+    """Reference: TestApps (test_distributed_array.py) — manual matmuls via
+    broadcast/expand_dims + reduction, and the pi-integration demo."""
+
+    def test_matmul1_broadcast_transpose(self):
+        def impl(app):
+            A = app.fromfunction(lambda x, y: x + y, (20, 30))
+            B = app.fromfunction(lambda x, y: x + y, (30, 40))
+            return (
+                app.broadcast_to(A.T, (40, 30, 20)).T
+                * app.broadcast_to(B, (20, 30, 40))
+            ).sum(axis=1)
+
+        run_both(impl)
+
+    def test_matmul2_expand_dims(self):
+        def impl(app):
+            A = app.fromfunction(lambda x, y: x + y, (20, 30))
+            B = app.fromfunction(lambda x, y: x + y, (30, 40))
+            return (app.expand_dims(A, 2) * B).sum(axis=1)
+
+        run_both(impl)
+
+    def test_matmul_big_fused(self):
+        # Reference: test_matmul_big1/2 — broadcasted products must run
+        # without materializing the 3-D intermediate (sized for the CPU test
+        # mesh; the no-temporaries guarantee itself is asserted via XLA
+        # memory analysis in test_fusion.py).
+        A = rt.fromfunction(lambda x, y: x + y, (300, 330))
+        B = rt.fromfunction(lambda x, y: x + y, (330, 360))
+        C = (rt.expand_dims(A, 2) * B).sum(axis=1)
+        c_12_4 = ((np.arange(330) + 12) * (np.arange(330) + 4)).sum()
+        assert float(C[12, 4]) == float(c_12_4)
+
+    def test_pi_integration(self):
+        def impl(app):
+            nsteps = 1000
+            step = 1.0 / nsteps
+            X = app.linspace(0.5 * step, 1.0 - 0.5 * step, nsteps)
+            Y = 1.0 / (1.0 + X * X)
+            pi = 4.0 * step * app.sum(Y)
+            return int(pi * 1e8)
+
+        run_both(impl)
+
+    def test_sum_asarray_kwarg(self):
+        # Reference: reduction asarray=True keeps the deferred result in
+        # (1,)-array form (sample pi demo; ramba.py:6778).
+        Y = rt.arange(1000).astype(np.float64)
+        s = rt.sum(Y, asarray=True)
+        assert s.shape == (1,)
+        assert float(s[0]) == float(np.arange(1000).sum())
+        s2 = Y.sum(asarray=True)
+        assert s2.shape == (1,)
+        assert float(s2[0]) == float(np.arange(1000).sum())
+
+
+class TestAverageMedian:
+    def test_average_plain(self):
+        run_both(lambda app: app.average(app.arange(20).reshape(4, 5)))
+
+    def test_average_axis_weights(self):
+        w = np.array([1.0, 2.0, 3.0, 4.0])
+
+        def impl(app):
+            a = app.arange(20).reshape(4, 5).astype(np.float64)
+            return app.average(a, axis=0, weights=w)
+
+        run_both(impl)
+
+    def test_average_full_weights(self):
+        w = np.arange(1.0, 21.0).reshape(4, 5)
+
+        def impl(app):
+            a = app.arange(20).reshape(4, 5).astype(np.float64)
+            return app.average(a, axis=1, weights=w)
+
+        run_both(impl)
+
+    def test_average_returned(self):
+        w = np.array([1.0, 2.0, 3.0])
+        e_avg, e_scl = np.average(np.arange(12.0).reshape(3, 4), axis=0,
+                                  weights=w, returned=True)
+        g_avg, g_scl = rt.average(rt.arange(12.0).reshape(3, 4), axis=0,
+                                  weights=w, returned=True)
+        np.testing.assert_allclose(_to_np(g_avg), e_avg)
+        np.testing.assert_allclose(_to_np(g_scl), np.broadcast_to(e_scl, e_avg.shape))
+
+    def test_average_errors(self):
+        a = rt.arange(12.0).reshape(3, 4)
+        with pytest.raises(TypeError):
+            rt.average(a, weights=np.ones(3))
+        with pytest.raises(ValueError):
+            rt.average(a, axis=0, weights=np.ones(4))
+
+    def test_median_axis(self):
+        def impl(app):
+            a = app.arange(24).reshape(4, 6).astype(np.float64)
+            return app.median(a), app.median(a, axis=1), app.median(a, axis=0)
+
+        run_both(impl)

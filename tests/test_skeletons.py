@@ -360,3 +360,74 @@ class TestReviewRegressions2:
         import os
 
         assert os.path.exists(p) and not os.path.exists(p + ".npy")
+
+
+class TestGroupbyVariations:
+    """Reference: TestGroupbyVariations (test_groupby.py) — groupby applied
+    to sliced / transposed views must still reduce and broadcast correctly.
+    The reference drives these through xarray; the group-label pattern
+    (day-of-year climatology + anomaly) is expressed directly here."""
+
+    def _labels(self, n, period=7):
+        return np.arange(n) % period
+
+    def test_mean_groupby_slice(self):
+        offset, slice_size = 25, 365
+        x = np.arange(400.0 * 2).reshape(2, 400)
+        labels = self._labels(slice_size, 365)
+
+        r = rt.fromarray(x)[:, offset:offset + slice_size]
+        gb = r.groupby(1, labels, num_groups=365)
+        final = (gb - gb.mean()).asarray()
+
+        xs = x[:, offset:offset + slice_size]
+        means = np.zeros((2, 365))
+        for g in range(365):
+            sel = xs[:, labels == g]
+            means[:, g] = sel.mean(axis=1) if sel.size else 0
+        expected = xs - means[:, labels]
+        np.testing.assert_allclose(final, expected)
+
+    def test_mean_groupby_transpose(self):
+        x = np.arange(35.0).reshape(7, 5)
+        labels = self._labels(7, 3)
+
+        r = rt.fromarray(x).T  # shape (5, 7); group along dim 1
+        gb = r.groupby(1, labels, num_groups=3)
+        final = (gb - gb.mean()).asarray()
+
+        xt = x.T
+        means = np.stack(
+            [xt[:, labels == g].mean(axis=1) for g in range(3)], axis=1
+        )
+        expected = xt - means[:, labels]
+        np.testing.assert_allclose(final, expected)
+
+    def test_mean_groupby_slice_transpose(self):
+        x = np.arange(120.0).reshape(10, 12)
+        r = rt.fromarray(x)[2:9, 1:11].T       # shape (10, 7)
+        xs = x[2:9, 1:11].T
+        labels = self._labels(7, 4)
+
+        gb = r.groupby(1, labels, num_groups=4)
+        got_mean = gb.mean().asarray()
+        means = np.stack(
+            [xs[:, labels == g].mean(axis=1) for g in range(4)], axis=1
+        )
+        np.testing.assert_allclose(got_mean, means)
+
+        final = (gb - gb.mean()).asarray()
+        np.testing.assert_allclose(final, xs - means[:, labels])
+
+    def test_groupby_labels_as_ramba_array(self):
+        # Reference passes ramba arrays as value_to_group (test_groupby.py:
+        # coord_days = ramba.array([...])).
+        x = np.arange(24.0).reshape(4, 6)
+        labels = rt.fromarray(np.array([0, 1, 0, 1, 2, 2]))
+        gb = rt.fromarray(x).groupby(1, labels, num_groups=3)
+        got = gb.sum().asarray()
+        expected = np.stack(
+            [x[:, [0, 2]].sum(axis=1), x[:, [1, 3]].sum(axis=1),
+             x[:, [4, 5]].sum(axis=1)], axis=1
+        )
+        np.testing.assert_allclose(got, expected)

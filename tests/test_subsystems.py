@@ -5,6 +5,8 @@ Reference test model: everything end-to-end differential vs NumPy
 (/root/reference/ramba/tests/test_distributed_array.py:240-260 run_both).
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -321,3 +323,35 @@ class TestDistributed:
 
     def test_local_devices(self):
         assert len(rt.distributed.local_devices()) == 8
+
+
+class TestPersistentCache:
+    """Reference: RAMBA_CACHE Numba disk cache (ramba.py:177-246) — here the
+    XLA compilation cache persisted to disk."""
+
+    def test_cache_dir_created_and_populated(self, tmp_path, monkeypatch):
+        import jax
+
+        from ramba_tpu import common
+
+        cache_dir = str(tmp_path / "xla_cache")
+        monkeypatch.setattr(common, "cache_env", cache_dir)
+        assert common.setup_persistent_cache() == cache_dir
+        assert os.path.isdir(cache_dir)
+        try:
+            # a fresh program structure so the executable is actually compiled
+            a = rt.arange(257.0)
+            b = rt.tanh(a) * 3.0 + rt.arange(257.0)
+            b.asarray()
+            rt.sync()
+            assert len(os.listdir(cache_dir)) >= 1
+        finally:
+            jax.config.update("jax_compilation_cache_dir", None)
+
+    def test_disabled_by_default(self, monkeypatch):
+        from ramba_tpu import common
+
+        monkeypatch.setattr(common, "cache_env", None)
+        assert common.setup_persistent_cache() is None
+        monkeypatch.setattr(common, "cache_env", "0")
+        assert common.setup_persistent_cache() is None
