@@ -1,0 +1,37 @@
+"""The reference's headline demo (reference: sample/test-ramba.py): a fused
+elementwise chain over a large array.  ``import ramba_tpu as np`` is the
+drop-in usage mode; every op below is collected lazily and compiled into a
+single XLA kernel per iteration.
+
+Run on a TPU host:  python examples/fused_chain.py
+Run on CPU (8 fake devices):
+  PYTHONPATH= JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python examples/fused_chain.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import time
+
+import ramba_tpu as np
+
+np.sync()
+t0 = time.time()
+A = np.arange(100 * 1000 * 1000) / 1000.0
+np.sync()
+print("Initialize array time:", time.time() - t0)
+
+for i in range(5):
+    t0 = time.time()
+    B = np.sin(A)
+    C = np.cos(A)
+    D = B * B + C ** 2
+    np.sync()
+    print("Iteration", i + 1, "time:", time.time() - t0)
+
+print("checksum (== num elements):", float(np.sum(D)))

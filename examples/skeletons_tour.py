@@ -1,0 +1,51 @@
+"""Tour of the algorithmic skeletons (reference: docs/index.md:83-267):
+smap / sreduce / scumulative / spmd / groupby, all running over sharded
+arrays on the device mesh.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import numpy as np
+
+import ramba_tpu as rt
+
+n = 1_000_000
+
+# smap: elementwise kernel written against NumPy, fused into the lazy graph
+a = rt.arange(n)
+b = rt.smap(lambda x: np.sqrt(x) + 1.0, a)
+print("smap:", float(b[10]))
+
+# smap_index: kernel sees the global index tuple
+c = rt.smap_index(lambda idx, x: x * (idx[0] % 2), a)
+print("smap_index:", float(rt.sum(c)))
+
+# sreduce with a worker/driver reducer split (tree reduction over shards)
+red = rt.SreduceReducer(lambda x, y: x + y, lambda x, y: x + y)
+total = rt.sreduce(lambda x: x * 2.0, red, 0.0, rt.arange(1000.0))
+print("sreduce:", float(total))
+
+# scumulative: parallel block scans + carry chain
+run_max = rt.scumulative(lambda x, c: np.maximum(x, c),
+                         lambda c, block: np.maximum(block, c),
+                         rt.fromarray(np.random.RandomState(0).rand(10000)))
+print("scumulative (running max tail):", float(run_max[-1]))
+
+# spmd: explicit per-worker kernels over local shards
+def double_local(v):
+    v.set_local(v.get_local() * 2.0)
+
+x = rt.arange(1024.0)
+rt.spmd(double_local, x)
+print("spmd:", float(x[3]))
+
+# groupby: segment reductions + group-broadcast ops (climatology/anomaly)
+days = np.arange(365) % 7
+temps = rt.fromarray(np.random.RandomState(1).rand(8, 365))
+gb = temps.groupby(1, days, num_groups=7)
+anomaly = gb - gb.mean()
+print("groupby anomaly shape:", anomaly.shape)

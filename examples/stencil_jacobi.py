@@ -1,0 +1,48 @@
+"""Distributed stencils: PRK-style star stencil and a Jacobi sweep.
+
+Reference: the stencil skeleton (docs/index.md "Stencils"; the PRK star
+benchmark README.md:271-299).  On TPU the halo exchange the reference does
+with point-to-point border messages is a GSPMD collective-permute over ICI,
+and single-chip runs use a hand-tiled Pallas kernel.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import time
+
+import numpy as np
+
+import ramba_tpu as rt
+
+
+@rt.stencil
+def star2(a):
+    return (
+        0.25 * (a[0, 1] + a[0, -1] + a[1, 0] + a[-1, 0])
+        + 0.125 * (a[0, 2] + a[0, -2] + a[2, 0] + a[-2, 0])
+    )
+
+
+@rt.stencil
+def jacobi(a):
+    return 0.25 * (a[0, 1] + a[0, -1] + a[1, 0] + a[-1, 0])
+
+
+n = 4096
+x = rt.fromarray(np.random.RandomState(0).rand(n, n).astype(np.float32))
+rt.sync()
+
+for name, kern, iters in [("star r=2", star2, 10), ("jacobi", jacobi, 10)]:
+    y = x
+    t0 = time.time()
+    for _ in range(iters):
+        y = rt.sstencil(kern, y)
+    s = float(rt.sum(y))  # completion barrier
+    dt = time.time() - t0
+    mflops = 13 * (n - 4) ** 2 * iters / dt / 1e6 if name.startswith("star") else 0
+    print(f"{name}: {iters} iters in {dt:.3f}s"
+          + (f"  ({mflops:.0f} PRK-MFlops)" if mflops else ""))

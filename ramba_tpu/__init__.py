@@ -31,10 +31,12 @@ from ramba_tpu.core.fuser import flush, sync, stats as fuser_stats  # noqa: F401
 from ramba_tpu.core.masked import MaskedArray  # noqa: F401
 from ramba_tpu.core.ndarray import ndarray  # noqa: F401
 from ramba_tpu.ops.creation import (  # noqa: F401
-    arange, array, asarray, copy, empty, empty_like, eye, fromarray,
-    fromfunction, full, full_like, identity, indices, init_array, linspace,
-    meshgrid, mgrid, ones, ones_like, tri, zeros, zeros_like,
+    arange, array, asarray, copy, create_array_with_divisions, empty,
+    empty_like, eye, fromarray, fromfunction, full, full_like, identity,
+    indices, init_array, linspace, meshgrid, mgrid, ones, ones_like, tri,
+    zeros, zeros_like,
 )
+from ramba_tpu.core.interop import implements, isscalar, result_type  # noqa: F401
 from ramba_tpu.ops.elementwise import *  # noqa: F401,F403
 from ramba_tpu.ops.elementwise import (  # noqa: F401
     allclose, array_equal, cbrt, clip, isclose, select, where,
@@ -45,10 +47,10 @@ from ramba_tpu.ops.reductions import (  # noqa: F401
     nansum, nanvar, prod, ptp, std, sum, var,
 )
 from ramba_tpu.ops.manipulation import (  # noqa: F401
-    argsort, array_split, atleast_1d, atleast_2d, broadcast_to, column_stack,
-    concatenate, diag, dstack, expand_dims, flip, hstack, moveaxis, pad,
-    ravel, repeat, reshape, roll, sort, split, squeeze, stack, swapaxes,
-    take, tile, transpose, tril, triu, vstack,
+    apply_index, argsort, array_split, atleast_1d, atleast_2d, broadcast_to,
+    column_stack, concatenate, diag, dstack, expand_dims, flip, hstack,
+    moveaxis, pad, ravel, repeat, reshape, reshape_copy, roll, sort, split,
+    squeeze, stack, swapaxes, take, tile, transpose, tril, triu, vstack,
 )
 from ramba_tpu.ops.linalg import (  # noqa: F401
     dot, einsum, inner, matmul, outer, set_matmul_precision, tensordot,
@@ -71,7 +73,10 @@ from ramba_tpu.parallel.constraints import (  # noqa: F401
 from ramba_tpu.utils.remote import get, jit, remote  # noqa: F401
 from ramba_tpu.utils import debug  # noqa: F401
 from ramba_tpu.utils import timing  # noqa: F401
-from ramba_tpu.utils.timing import get_timing, timing_summary  # noqa: F401
+from ramba_tpu.utils.timing import (  # noqa: F401
+    get_timing, print_comm_stats, timing_summary,
+)
+from ramba_tpu.utils.timing import reset as reset_timing  # noqa: F401
 
 # -- numpy namespace constants / dtypes --------------------------------------
 newaxis = None

@@ -8,14 +8,37 @@ work through the NumPy dispatch protocol.
 
 from __future__ import annotations
 
+import numpy as np
+
 HANDLED_FUNCTIONS: dict = {}
 
 
 def implements(np_function):
-    """Register an implementation for a NumPy function."""
+    """Register an implementation for a NumPy function (public extension
+    point; reference: @implements, ramba.py:8536-8543)."""
 
     def decorator(func):
         HANDLED_FUNCTIONS[np_function] = func
         return func
 
     return decorator
+
+
+def isscalar(x) -> bool:
+    """Reference: ramba.isscalar (ramba.py:9854-9857) — 0-d distributed
+    arrays count as scalars."""
+    from ramba_tpu.core.ndarray import ndarray
+
+    if isinstance(x, ndarray):
+        return x.ndim == 0
+    return np.isscalar(x)
+
+
+def result_type(*args):
+    """Reference: ramba.result_type (ramba.py:9833-9851) — numpy promotion
+    with distributed arrays contributing their dtype."""
+    from ramba_tpu.core.ndarray import ndarray
+
+    return np.result_type(
+        *[a.dtype if isinstance(a, ndarray) else a for a in args]
+    )

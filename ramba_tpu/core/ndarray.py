@@ -258,7 +258,11 @@ class ndarray:
         """Gather to a host NumPy array (reference: ndarray.asarray,
         ramba.py:5735-5765 — per-worker get_view + driver assembly; here a
         single device-to-host transfer)."""
-        return np.asarray(self._value())
+        from ramba_tpu.utils import timing as _timing
+
+        out = np.asarray(self._value())
+        _timing.note_transfer("device_to_host", out.nbytes)
+        return out
 
     def __array__(self, dtype=None, copy=None):
         a = self.asarray()
@@ -380,6 +384,11 @@ class ndarray:
 
     def ravel(self):
         return self.reshape(-1)
+
+    def reshape_copy(self, *shape):
+        """Materialized reshape (reference: ndarray.reshape_copy,
+        ramba.py:6719-6720)."""
+        return self.reshape(*shape).copy()
 
     def flatten(self):
         return self.reshape(-1).copy()
@@ -551,6 +560,10 @@ def as_exprable(x) -> Expr:
 
 def _device_put_default(x):
     x = np.asarray(x) if not isinstance(x, jax.Array) else x
+    if isinstance(x, np.ndarray):
+        from ramba_tpu.utils import timing as _timing
+
+        _timing.note_transfer("host_to_device", x.nbytes)
     try:
         return jax.device_put(x, _mesh.default_sharding(x.shape))
     except Exception:

@@ -145,10 +145,65 @@ def init_array(shape, filler, dtype=float):
     return fromfunction(filler, shape, dtype=dtype)
 
 
+def _resolve_distribution(distribution, shape):
+    """Accept a PartitionSpec, NamedSharding, or per-dim split counts."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if distribution is None:
+        return None
+    if isinstance(distribution, NamedSharding):
+        return distribution
+    if isinstance(distribution, PartitionSpec):
+        return NamedSharding(_mesh.get_mesh(), distribution)
+    splits = tuple(int(s) for s in distribution)
+    if len(splits) != len(shape):
+        raise ValueError(
+            f"distribution has {len(splits)} entries for a {len(shape)}-d array"
+        )
+    return NamedSharding(_mesh.get_mesh(), _mesh.spec_from_splits(splits))
+
+
 def fromarray(arr, dtype=None, distribution=None):
-    """Distribute a host array (reference: fromarray, ramba.py:8727-8760)."""
+    """Distribute a host array (reference: fromarray, ramba.py:8727-8760).
+    ``distribution`` may be a PartitionSpec, NamedSharding, or a per-dim
+    split-count tuple (the TPU reading of the reference's explicit
+    distributions)."""
+    import jax
+
     a = np.asarray(arr, dtype=dtype)
+    sh = _resolve_distribution(distribution, a.shape)
+    if sh is not None:
+        from ramba_tpu.utils import timing as _timing
+
+        _timing.note_transfer("host_to_device", a.nbytes)
+        return ndarray(Const(jax.device_put(a, sh)))
     return ndarray(Const(_device_put_default(a)))
+
+
+def create_array_with_divisions(shape, divisions, local_border=0, dtype=None):
+    """Create an (uninitialized) array with an explicit distribution
+    (reference: create_array_with_divisions, ramba.py:8552-8560, where
+    ``divisions`` is a per-worker (starts, ends) index-range array).  Here
+    the ranges are reduced to per-dimension split counts and mapped onto the
+    mesh; ``local_border`` is accepted for API parity (halo storage is
+    managed by XLA on TPU)."""
+    shape = _canon_shape(shape)
+    div = np.asarray(divisions)
+    if div.ndim == 3 and div.shape[1] == 2 and div.shape[2] == len(shape):
+        splits = tuple(
+            len({(int(w[0, d]), int(w[1, d])) for w in div})
+            for d in range(len(shape))
+        )
+    else:
+        splits = tuple(int(s) for s in divisions)
+    import jax
+
+    sh = _resolve_distribution(splits, shape)
+    dt = jnp.dtype(np.dtype(float if dtype is None else dtype))
+    # Allocate directly under the target sharding (no intermediate
+    # default-sharded placement).
+    val = jax.jit(lambda: jnp.zeros(shape, dt), out_shardings=sh)()
+    return ndarray(Const(val))
 
 
 def asarray(a, dtype=None):

@@ -11,6 +11,8 @@ owns the resharding.
 
 from __future__ import annotations
 
+import builtins
+
 import numpy as np
 
 from ramba_tpu.core.expr import Node
@@ -20,6 +22,68 @@ from ramba_tpu.ops.creation import asarray
 
 def reshape(a, shape, order="C"):
     return asarray(a).reshape(shape)
+
+
+def reshape_copy(a, shape):
+    """Materialized (non-view) reshape (reference: reshape_copy — the
+    general element-redistribution path, ramba.py:9241-9277,2409-2491;
+    here XLA owns the cross-shard data movement)."""
+    return asarray(a).reshape(shape).copy()
+
+
+def apply_index(shape, index):
+    """Compute the result shape of basic indexing plus the canonicalized
+    index (reference: apply_index, ramba.py:5335-5347: returns
+    ``(dim_shapes, (canonical_index, axismap))``).
+
+    Supports integers (NumPy bounds semantics — IndexError when out of
+    range), slices, Ellipsis, and None/newaxis.  ``canonical_index`` is one
+    concrete ``slice`` per *base* dimension (integers become length-1
+    slices); ``axismap`` lists the base dims kept in the result
+    (integer-indexed dims are dropped; newaxis dims map to no base dim).
+    """
+    if not isinstance(index, tuple):
+        index = (index,)
+    if builtins.any(it is Ellipsis for it in index):
+        pos = next(p for p, it in enumerate(index) if it is Ellipsis)
+        n_spec = builtins.sum(
+            1 for it in index if it is not None and it is not Ellipsis
+        )
+        fill = (slice(None),) * (len(shape) - n_spec)
+        index = index[:pos] + fill + index[pos + 1:]
+    # pad with full slices for unmentioned trailing dims
+    n_spec = builtins.sum(1 for it in index if it is not None)
+    index = index + (slice(None),) * (len(shape) - n_spec)
+
+    cindex = []
+    axismap = []
+    dim_shapes = []
+    d = 0  # base dim cursor
+    for it in index:
+        if it is None:
+            dim_shapes.append(1)
+            continue
+        size = shape[d]
+        if isinstance(it, (int, np.integer)):
+            i = int(it)
+            if not (-size <= i < size):
+                raise IndexError(
+                    f"index {i} is out of bounds for axis {d} with size {size}"
+                )
+            i += size if i < 0 else 0
+            cindex.append(slice(i, i + 1, 1))
+        elif isinstance(it, slice):
+            start, stop, step = it.indices(size)
+            cindex.append(slice(start, stop, step))
+            axismap.append(d)
+            n = max(0, -(-(stop - start) // step) if step > 0
+                    else -(-(start - stop) // -step))
+            dim_shapes.append(n)
+        else:
+            raise TypeError(f"apply_index handles basic indexing only, got "
+                            f"{type(it).__name__}")
+        d += 1
+    return tuple(dim_shapes), (tuple(cindex), axismap)
 
 
 def ravel(a):

@@ -176,6 +176,50 @@ def default_spec(shape: Sequence[int], mesh: Optional[Mesh] = None) -> P:
     return P(*entries)
 
 
+def spec_from_splits(splits: Sequence[int], mesh: Optional[Mesh] = None) -> P:
+    """Best-effort PartitionSpec for explicit per-dimension split counts
+    (the TPU mapping of the reference's explicit ``divisions``/distribution
+    arguments, e.g. create_array_with_divisions, ramba.py:8552-8560).
+
+    Each dim with splits>1 greedily claims unused mesh axes whose sizes
+    multiply to the requested split; dims whose request can't be met by the
+    mesh are left replicated (best-effort, like the reference's schedule
+    solver ignoring infeasible constraints)."""
+    mesh = mesh or get_mesh()
+    free = dict(mesh.shape)
+    entries = []
+    for s in splits:
+        s = int(s)
+        if s <= 1:
+            entries.append(None)
+            continue
+        # single axis exact match first, then exhaustive subset search
+        # (meshes have <= ~4 axes, so 2^k subsets is trivial)
+        names = None
+        for name, size in free.items():
+            if size == s:
+                names = [name]
+                break
+        if names is None:
+            free_items = list(free.items())
+            for r in range(2, len(free_items) + 1):
+                for combo in itertools.combinations(free_items, r):
+                    if math.prod(sz for _, sz in combo) == s:
+                        names = [nm for nm, _ in combo]
+                        break
+                if names:
+                    break
+        if names:
+            for nm in names:
+                free.pop(nm)
+            entries.append(names[0] if len(names) == 1 else tuple(names))
+        else:
+            entries.append(None)
+    while entries and entries[-1] is None:
+        entries.pop()
+    return P(*entries)
+
+
 def default_sharding(shape: Sequence[int]) -> NamedSharding:
     return NamedSharding(get_mesh(), default_spec(shape))
 

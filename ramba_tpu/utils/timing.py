@@ -79,10 +79,46 @@ def timer(name: str, parent: Optional[str] = None):
             add_sub_time(parent, name, dt)
 
 
+# Host<->device transfer accounting (reference: per-queue byte/pickle-time
+# stats, print_comm_stats ramba.py:4120-4142 / ramba_queue_zmq.py:127-135.
+# On TPU the queues are gone; the host boundary transfers are what remain
+# observable — inter-device traffic is XLA collectives over ICI, visible
+# only to the profiler).
+comm_stats: dict = {
+    "host_to_device_bytes": 0, "host_to_device_count": 0,
+    "device_to_host_bytes": 0, "device_to_host_count": 0,
+}
+
+
+def note_transfer(direction: str, nbytes: int) -> None:
+    comm_stats[f"{direction}_bytes"] += int(nbytes)
+    comm_stats[f"{direction}_count"] += 1
+
+
+def print_comm_stats(file=None) -> None:
+    """Reference: print_comm_stats (ramba.py:4120-4142)."""
+    file = file or sys.stderr
+    print("=== ramba_tpu comm stats (host boundary) ===", file=file)
+    print(
+        f"  host->device {comm_stats['host_to_device_bytes']:>14,d} B  "
+        f"x{comm_stats['host_to_device_count']}", file=file,
+    )
+    print(
+        f"  device->host {comm_stats['device_to_host_bytes']:>14,d} B  "
+        f"x{comm_stats['device_to_host_count']}", file=file,
+    )
+    print(
+        "  (device<->device traffic rides ICI/DCN collectives inside XLA; "
+        "use jax.profiler for per-collective stats)", file=file,
+    )
+
+
 def reset() -> None:
     time_dict.clear()
     sub_time_dict.clear()
     per_func.clear()
+    for k in comm_stats:
+        comm_stats[k] = 0
 
 
 def get_timing() -> dict:

@@ -1,0 +1,126 @@
+"""One-shot TPU perf experiments: chain decomposition + stencil variants.
+
+Run directly on the real chip. Each measurement uses a scalar fetch as the
+completion barrier (block_until_ready does not synchronize through the
+remote-dispatch tunnel). Results guide kernel optimization; this script is
+not part of the test suite.
+"""
+
+import time
+
+import numpy as np
+
+
+def timeit(fn, reps=3):
+    fn()  # compile
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    n = 1_000_000_000
+
+    @jax.jit
+    def write_only():
+        a = jax.lax.iota(jnp.float32, n)
+        d = a * 2.0
+        return d, jnp.sum(d)
+
+    @jax.jit
+    def chain():
+        a = jax.lax.iota(jnp.float32, n) / 1000.0
+        b = jnp.sin(a)
+        c = jnp.cos(a)
+        d = b * b + c ** 2
+        return d, jnp.sum(d)
+
+    @jax.jit
+    def sum_only():
+        a = jax.lax.iota(jnp.float32, n) / 1000.0
+        b = jnp.sin(a)
+        c = jnp.cos(a)
+        return jnp.sum(b * b + c ** 2)
+
+    for name, f in [("write+sum", write_only), ("chain", chain),
+                    ("sum_only", sum_only)]:
+        t = timeit(lambda f=f: float(jax.tree.leaves(f())[-1]))
+        print(f"{name}: {t*1e3:.1f} ms")
+
+    # ---- stencil variants on 8192^2 f32, PRK star r=2 ----
+    sn, sk = 8192, 30
+    x0 = np.random.RandomState(0).rand(sn, sn).astype(np.float32)
+    flops = 13 * (sn - 4) * (sn - 4) * sk
+
+    def report(name, t):
+        print(f"{name}: {t/sk*1e3:.2f} ms/iter, {flops/t/1e6:.0f} PRK-MFlops")
+
+    def star_xla(a):
+        # shifted-slice path over the interior, zero borders
+        H, W = a.shape
+        i = a[2:-2, 2:-2]
+        val = (0.25 * (a[2:-2, 3:-1] + a[2:-2, 1:-3]
+                       + a[3:-1, 2:-2] + a[1:-3, 2:-2])
+               + 0.125 * (a[2:-2, 4:] + a[2:-2, :-4]
+                          + a[4:, 2:-2] + a[:-4, 2:-2]))
+        return jnp.zeros_like(a).at[2:-2, 2:-2].set(val)
+
+    @jax.jit
+    def xla_chain(a):
+        for _ in range(sk):
+            a = star_xla(a)
+        return a, jnp.sum(a)
+
+    xj = jnp.asarray(x0)
+    t = timeit(lambda: float(xla_chain(xj)[1]))
+    report("stencil XLA shifted-slice", t)
+
+    # conv formulation (linear stencils only; ceiling probe)
+    kern = np.zeros((5, 5), np.float32)
+    kern[2, 3] = kern[2, 1] = kern[3, 2] = kern[1, 2] = 0.25
+    kern[2, 4] = kern[2, 0] = kern[4, 2] = kern[0, 2] = 0.125
+    kj = jnp.asarray(kern)[None, None]
+
+    @jax.jit
+    def conv_chain(a):
+        v = a[None, None]
+        for _ in range(sk):
+            out = jax.lax.conv_general_dilated(
+                v, kj, (1, 1), [(2, 2), (2, 2)])
+            # zero borders to match sstencil semantics
+            v = jnp.zeros_like(out).at[:, :, 2:-2, 2:-2].set(
+                out[:, :, 2:-2, 2:-2])
+        return v, jnp.sum(v)
+
+    t = timeit(lambda: float(conv_chain(xj)[1]))
+    report("stencil lax.conv", t)
+
+    # current pallas path through the framework
+    import ramba_tpu as rt
+
+    @rt.stencil
+    def star2(a):
+        return (0.25 * (a[0, 1] + a[0, -1] + a[1, 0] + a[-1, 0])
+                + 0.125 * (a[0, 2] + a[0, -2] + a[2, 0] + a[-2, 0]))
+
+    xr = rt.fromarray(x0)
+    rt.sync()
+
+    def pallas_chain():
+        y = xr
+        for _ in range(sk):
+            y = rt.sstencil(star2, y)
+        return float(rt.sum(y))
+
+    t = timeit(pallas_chain)
+    report("stencil pallas (framework)", t)
+
+
+if __name__ == "__main__":
+    main()
