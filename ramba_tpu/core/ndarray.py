@@ -589,6 +589,17 @@ def _fix_reshape(size, shape):
     return shape
 
 
+def expand_ellipsis(idx: tuple, ndim: int) -> tuple:
+    """Replace an Ellipsis with the full slices it stands for (identity
+    check: ``in`` would do elementwise == on array items)."""
+    if builtins.any(it is Ellipsis for it in idx):
+        pos = next(p for p, it in enumerate(idx) if it is Ellipsis)
+        n_specified = sum(1 for i in idx if i is not None and i is not Ellipsis)
+        fill = (slice(None),) * (ndim - n_specified)
+        idx = idx[:pos] + fill + idx[pos + 1:]
+    return idx
+
+
 def _classify_index(idx, shape):
     """Split an index into basic / boolean-mask / advanced-integer cases.
 
@@ -601,12 +612,7 @@ def _classify_index(idx, shape):
         return "mask", fromarray_auto(idx)
     if not isinstance(idx, tuple):
         idx = (idx,)
-    # expand ellipsis (identity check: `in` would do elementwise == on arrays)
-    if builtins.any(it is Ellipsis for it in idx):
-        pos = next(p for p, it in enumerate(idx) if it is Ellipsis)
-        n_specified = sum(1 for i in idx if i is not None and i is not Ellipsis)
-        fill = (slice(None),) * (len(shape) - n_specified)
-        idx = idx[:pos] + fill + idx[pos + 1:]
+    idx = expand_ellipsis(idx, len(shape))
     has_array = any(
         isinstance(i, (ndarray, np.ndarray, list, jax.Array)) for i in idx
     )

@@ -42,15 +42,11 @@ def apply_index(shape, index):
     slices); ``axismap`` lists the base dims kept in the result
     (integer-indexed dims are dropped; newaxis dims map to no base dim).
     """
+    from ramba_tpu.core.ndarray import expand_ellipsis
+
     if not isinstance(index, tuple):
         index = (index,)
-    if builtins.any(it is Ellipsis for it in index):
-        pos = next(p for p, it in enumerate(index) if it is Ellipsis)
-        n_spec = builtins.sum(
-            1 for it in index if it is not None and it is not Ellipsis
-        )
-        fill = (slice(None),) * (len(shape) - n_spec)
-        index = index[:pos] + fill + index[pos + 1:]
+    index = expand_ellipsis(index, len(shape))
     # pad with full slices for unmentioned trailing dims
     n_spec = builtins.sum(1 for it in index if it is not None)
     index = index + (slice(None),) * (len(shape) - n_spec)
@@ -74,10 +70,15 @@ def apply_index(shape, index):
             cindex.append(slice(i, i + 1, 1))
         elif isinstance(it, slice):
             start, stop, step = it.indices(size)
-            cindex.append(slice(start, stop, step))
-            axismap.append(d)
             n = max(0, -(-(stop - start) // step) if step > 0
                     else -(-(start - stop) // -step))
+            # A reverse slice reaching index 0 canonicalizes to stop=-1 from
+            # slice.indices(), which as a literal index means "last element";
+            # store stop=None so the slice is directly reusable.
+            if step < 0 and stop < 0:
+                stop = None
+            cindex.append(slice(start, stop, step))
+            axismap.append(d)
             dim_shapes.append(n)
         else:
             raise TypeError(f"apply_index handles basic indexing only, got "

@@ -88,3 +88,84 @@ class TestPallasStencil:
         e = np.zeros_like(x)
         e[:, 1:-1] = np.maximum(x[:, :-2], x[:, 2:])
         np.testing.assert_allclose(out, e)
+
+
+@pytest.fixture
+def no_fallback(monkeypatch):
+    """Make any silent fall-back to the XLA or padded path a hard failure."""
+    import ramba_tpu.skeletons as sk
+
+    def boom(*a, **k):
+        raise AssertionError("padded path used, fast path expected")
+
+    monkeypatch.setattr(stencil_pallas, "_run_padded", boom)
+    monkeypatch.setattr(sk, "_pallas_fallback_warned", False)
+    import warnings as _w
+
+    real_warn = _w.warn
+
+    def strict_warn(msg, *a, **k):
+        if "pallas stencil" in str(msg):
+            raise AssertionError(f"fallback: {msg}")
+        return real_warn(msg, *a, **k)
+
+    monkeypatch.setattr("warnings.warn", strict_warn)
+
+
+class TestPallasFastPath:
+    """The aligned-shape kernel: no pad pass, double-buffered slab DMA."""
+
+    def test_eligibility(self):
+        import jax.numpy as jnp
+
+        a = jnp.zeros((40, 128), jnp.float32)
+        b = jnp.zeros((40, 130), jnp.float32)  # W not 128-aligned
+        c = jnp.zeros((37, 128), jnp.float32)  # H not 8-aligned
+        assert stencil_pallas._fast_eligible((-2, -2), (2, 2), [a])
+        assert not stencil_pallas._fast_eligible((-2, -2), (2, 2), [b])
+        assert not stencil_pallas._fast_eligible((-2, -2), (2, 2), [c])
+
+    def test_fast_star2_matches_numpy(self, interpret_mode, no_fallback):
+        x = np.random.RandomState(0).rand(40, 128).astype(np.float32)
+        out = rt.sstencil(_prk_star2(), rt.fromarray(x)).asarray()
+        np.testing.assert_allclose(out, _star2_numpy(x), rtol=1e-5, atol=1e-6)
+
+    def test_fast_multiblock(self, interpret_mode, no_fallback, monkeypatch):
+        # force several grid steps so the double-buffer rotation is exercised
+        monkeypatch.setattr(stencil_pallas, "_BH", 8)
+        x = np.random.RandomState(1).rand(64, 256).astype(np.float32)
+        out = rt.sstencil(_prk_star2(), rt.fromarray(x)).asarray()
+        np.testing.assert_allclose(out, _star2_numpy(x), rtol=1e-5, atol=1e-6)
+
+    def test_fast_single_block(self, interpret_mode, no_fallback, monkeypatch):
+        monkeypatch.setattr(stencil_pallas, "_BH", 64)
+        x = np.random.RandomState(2).rand(32, 128).astype(np.float32)
+        out = rt.sstencil(_prk_star2(), rt.fromarray(x)).asarray()
+        np.testing.assert_allclose(out, _star2_numpy(x), rtol=1e-5, atol=1e-6)
+
+    def test_fast_two_inputs(self, interpret_mode, no_fallback, monkeypatch):
+        monkeypatch.setattr(stencil_pallas, "_BH", 16)
+
+        @rt.stencil
+        def mix(a, b):
+            return a[0, 0] + 0.5 * (b[-1, 0] + b[1, 0])
+
+        x = np.random.RandomState(3).rand(48, 128).astype(np.float32)
+        y = np.random.RandomState(4).rand(48, 128).astype(np.float32)
+        out = rt.sstencil(mix, rt.fromarray(x), rt.fromarray(y)).asarray()
+        e = np.zeros_like(x)
+        e[1:-1, :] = x[1:-1, :] + 0.5 * (y[:-2, :] + y[2:, :])
+        np.testing.assert_allclose(out, e, rtol=1e-6)
+
+    def test_fast_asymmetric(self, interpret_mode, no_fallback, monkeypatch):
+        monkeypatch.setattr(stencil_pallas, "_BH", 8)
+
+        @rt.stencil
+        def shifted(a):
+            return a[-3, 0] + a[0, 5]
+
+        x = np.random.RandomState(5).rand(40, 128).astype(np.float32)
+        out = rt.sstencil(shifted, rt.fromarray(x)).asarray()
+        e = np.zeros_like(x)
+        e[3:, :-5] = x[:-3, :-5] + x[3:, 5:]
+        np.testing.assert_allclose(out, e)

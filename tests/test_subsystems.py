@@ -461,3 +461,15 @@ class TestApiParityReviewFixes:
         rt.reset_timing()
         rt.fromarray(np.arange(4096.0), distribution=(8,))
         assert rt.timing.comm_stats["host_to_device_bytes"] >= 4096 * 8
+
+
+class TestApplyIndexCanonical:
+    def test_negative_step_slice_reusable(self):
+        ds, (ci, _) = rt.apply_index((5,), (slice(None, None, -1),))
+        assert ds == (5,)
+        x = np.arange(5)
+        np.testing.assert_array_equal(x[ci[0]], x[::-1])
+        ds2, (ci2, _) = rt.apply_index((10,), (slice(8, 2, -2),))
+        assert ds2 == (3,)
+        np.testing.assert_array_equal(np.arange(10)[ci2[0]],
+                                      np.arange(10)[8:2:-2])
