@@ -149,8 +149,49 @@ _UNARY_METHODS = [
 _REDUCTIONS = ["sum", "prod", "min", "max", "any", "all", "mean"]
 
 
+class ndarray_flags:
+    """Minimal flags object (reference: ndarray_flags ramba.py:5365 and
+    set_writeable_executor ramba.py:5358-5365)."""
+
+    __slots__ = ("_arr",)
+
+    def __init__(self, arr):
+        self._arr = arr
+
+    @property
+    def writeable(self):
+        return not self._arr._readonly
+
+    @writeable.setter
+    def writeable(self, value):
+        arr = self._arr
+        if value:
+            # every ancestor must be writable (write_expr recurses through
+            # the whole view chain, so the flag must agree with it)
+            base = arr._base
+            while base is not None:
+                if base._readonly:
+                    raise ValueError(
+                        "cannot set WRITEABLE flag to True of this array"
+                    )
+                base = base._base
+        arr._readonly = not value
+
+    def __getitem__(self, name):
+        if name in ("WRITEABLE", "writeable"):
+            return self.writeable
+        raise KeyError(name)
+
+    def __setitem__(self, name, value):
+        if name in ("WRITEABLE", "writeable"):
+            self.writeable = value
+        else:
+            raise KeyError(name)
+
+
 class ndarray:
-    __slots__ = ("_expr", "_base", "_view", "_aval", "_seq", "__weakref__")
+    __slots__ = ("_expr", "_base", "_view", "_aval", "_seq", "_readonly",
+                 "__weakref__")
 
     # Win dispatch over numpy arrays in mixed expressions.
     __array_priority__ = 100.0
@@ -161,6 +202,8 @@ class ndarray:
         self._base = base
         self._view = view
         self._expr = None
+        # views of read-only arrays are read-only (numpy semantics)
+        self._readonly = base._readonly if base is not None else False
         if base is not None:
             self._aval = (
                 aval if aval is not None
@@ -200,10 +243,16 @@ class ndarray:
         return self._view.read(self._base.read_expr())
 
     def write_expr(self, value: Expr):
+        if self._readonly:
+            raise ValueError("assignment destination is read-only")
         if self._base is None:
             self._set_expr(value)
         else:
             self._base.write_expr(self._view.write(self._base.read_expr(), value))
+
+    @property
+    def flags(self):
+        return ndarray_flags(self)
 
     # -- basic properties -----------------------------------------------------
 

@@ -30,28 +30,43 @@ def _spec_tuple(shape):
     return tuple(_mesh.default_spec(shape))
 
 
-def empty(shape, dtype=float, local_border=0):
+def _spec_tuple_for(shape, distribution=None):
+    """Spec tuple for a new array, honoring an explicit ``distribution``
+    (reference: the optional distribution argument on every array-generating
+    routine, docs/index.md "Optional Distribution Arguments")."""
+    if distribution is None:
+        return _spec_tuple(shape)
+    sh = _resolve_distribution(distribution, shape)
+    if sh.mesh.devices.tolist() != _mesh.get_mesh().devices.tolist():
+        raise ValueError(
+            "distribution's NamedSharding is over a different mesh than the "
+            "installed global mesh; call ramba_tpu.set_mesh(...) first"
+        )
+    return tuple(sh.spec)
+
+
+def empty(shape, dtype=float, local_border=0, distribution=None):
     """`local_border` accepted for API parity with the reference's halo
     padding (ramba.py:5409 ndarray(..., local_border=)); halos here are
     carried by the stencil engine (parallel/stencil.py), not the array."""
-    return full(shape, 0, dtype)
+    return full(shape, 0, dtype, distribution=distribution)
 
 
-def zeros(shape, dtype=float, local_border=0):
-    return full(shape, 0, dtype)
+def zeros(shape, dtype=float, local_border=0, distribution=None):
+    return full(shape, 0, dtype, distribution=distribution)
 
 
-def ones(shape, dtype=float, local_border=0):
-    return full(shape, 1, dtype)
+def ones(shape, dtype=float, local_border=0, distribution=None):
+    return full(shape, 1, dtype, distribution=distribution)
 
 
-def full(shape, fill_value, dtype=None, local_border=0):
+def full(shape, fill_value, dtype=None, local_border=0, distribution=None):
     shape = _canon_shape(shape)
     if dtype is None:
         dtype = np.result_type(fill_value)
     dtype = np.dtype(jnp.dtype(dtype))
     return ndarray(
-        Node("full", (shape, str(dtype), _spec_tuple(shape)),
+        Node("full", (shape, str(dtype), _spec_tuple_for(shape, distribution)),
              [as_exprable(fill_value)])
     )
 
@@ -63,26 +78,27 @@ def _like_shape_dtype(a, dtype):
     return a.shape, (dtype or a.dtype)
 
 
-def empty_like(a, dtype=None):
-    return zeros_like(a, dtype)
+def empty_like(a, dtype=None, distribution=None):
+    return zeros_like(a, dtype, distribution=distribution)
 
 
-def zeros_like(a, dtype=None):
+def zeros_like(a, dtype=None, distribution=None):
     shape, dtype = _like_shape_dtype(a, dtype)
-    return full(shape, 0, dtype)
+    return full(shape, 0, dtype, distribution=distribution)
 
 
-def ones_like(a, dtype=None):
+def ones_like(a, dtype=None, distribution=None):
     shape, dtype = _like_shape_dtype(a, dtype)
-    return full(shape, 1, dtype)
+    return full(shape, 1, dtype, distribution=distribution)
 
 
-def full_like(a, fill_value, dtype=None):
+def full_like(a, fill_value, dtype=None, distribution=None):
     shape, dtype = _like_shape_dtype(a, dtype)
-    return full(shape, fill_value, dtype)
+    return full(shape, fill_value, dtype, distribution=distribution)
 
 
-def arange(start, stop=None, step=None, dtype=None, local_border=0):
+def arange(start, stop=None, step=None, dtype=None, local_border=0,
+           distribution=None):
     """Reference: arange_executor emits `res = index[0]+global_start` into the
     fused kernel (ramba.py:8952-8972); here it is a sharded iota."""
     if stop is None:
@@ -99,50 +115,53 @@ def arange(start, stop=None, step=None, dtype=None, local_border=0):
     dtype = np.dtype(jnp.dtype(dtype))
     shape = (n,)
     return ndarray(
-        Node("arange", (n, str(dtype), _spec_tuple(shape)),
+        Node("arange", (n, str(dtype), _spec_tuple_for(shape, distribution)),
              [E.as_expr(start), E.as_expr(step)])
     )
 
 
-def linspace(start, stop, num=50, endpoint=True, dtype=None):
+def linspace(start, stop, num=50, endpoint=True, dtype=None,
+             distribution=None):
     if dtype is None:
         dtype = np.dtype(jnp.dtype(float))
     shape = (int(num),)
     return ndarray(
         Node("linspace", (int(num), bool(endpoint), str(np.dtype(dtype)),
-                          _spec_tuple(shape)),
+                          _spec_tuple_for(shape, distribution)),
              [E.as_expr(start), E.as_expr(stop)])
     )
 
 
-def eye(N, M=None, k=0, dtype=float):
+def eye(N, M=None, k=0, dtype=float, distribution=None):
     M = N if M is None else M
     shape = (int(N), int(M))
     return ndarray(
         Node("eye", (int(N), int(M), int(k), str(np.dtype(jnp.dtype(dtype))),
-                     _spec_tuple(shape)), [])
+                     _spec_tuple_for(shape, distribution)), [])
     )
 
 
-def identity(n, dtype=float):
-    return eye(n, dtype=dtype)
+def identity(n, dtype=float, distribution=None):
+    return eye(n, dtype=dtype, distribution=distribution)
 
 
-def fromfunction(function, shape, dtype=float, **kwargs):
+def fromfunction(function, shape, dtype=float, distribution=None, **kwargs):
     """Reference: init_fromfunction / Filler.PER_ELEMENT
     (ramba.py:8684-8712,1535-1595).  ``function`` must be jax-traceable; it
     receives index grids and runs fused inside the flush."""
     shape = _canon_shape(shape)
     dt = str(np.dtype(jnp.dtype(dtype))) if dtype is not None else None
     return ndarray(
-        Node("fromfunction", (shape, dt, _spec_tuple(shape), function, True), [])
+        Node("fromfunction",
+             (shape, dt, _spec_tuple_for(shape, distribution), function, True),
+             [])
     )
 
 
-def init_array(shape, filler, dtype=float):
+def init_array(shape, filler, dtype=float, distribution=None):
     """Reference API: ramba.init_array with a per-element filler
     (docs/index.md; ramba.py:8684-8712)."""
-    return fromfunction(filler, shape, dtype=dtype)
+    return fromfunction(filler, shape, dtype=dtype, distribution=distribution)
 
 
 def _resolve_distribution(distribution, shape):

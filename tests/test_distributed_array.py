@@ -973,3 +973,72 @@ class TestDel:
         del t
         a += 1
         np.testing.assert_allclose(a.asarray(), np.arange(20.0) + 1)
+
+
+class TestDistributionArgument:
+    """Reference docs: 'all the functions in Ramba that generate a new array
+    take an additional distribution parameter' (docs/index.md)."""
+
+    def test_creation_with_distribution(self):
+        from jax.sharding import PartitionSpec as P
+
+        n = 1024
+        for make in (
+            lambda d: rt.zeros((n, 8), distribution=d),
+            lambda d: rt.ones((n, 8), distribution=d),
+            lambda d: rt.full((n, 8), 3.0, distribution=d),
+            lambda d: rt.fromfunction(lambda i, j: i + j, (n, 8), distribution=d),
+        ):
+            for dist in ((8, 1), P("d0"),):
+                a = make(dist)
+                assert a.shape == (n, 8)
+                v = a._value()
+                assert len(v.addressable_shards) == 8
+                # 8-way split along dim 0 -> each shard has n/8 rows
+                assert v.addressable_shards[0].data.shape[0] == n // 8
+
+    def test_arange_linspace_distribution(self):
+        a = rt.arange(4096, distribution=(8,))
+        assert len(a._value().addressable_shards) == 8
+        le = rt.linspace(0.0, 1.0, 4096, distribution=(8,))
+        np.testing.assert_allclose(le.asarray(), np.linspace(0.0, 1.0, 4096))
+
+    def test_elementwise_preserves_distribution(self):
+        # docs: 'Elementwise operations on such arrays maintain this selected
+        # partitioning on the output arrays' — GSPMD propagates shardings
+        a = rt.zeros((1024, 8), distribution=(8, 1)) + 1.0
+        v = a._value()
+        assert v.addressable_shards[0].data.shape[0] == 1024 // 8
+
+
+class TestFlags:
+    """Reference: ndarray_flags + set_writeable (ramba.py:5365,5358-5365)."""
+
+    def test_readonly_blocks_writes(self):
+        a = rt.arange(10).astype(np.float64)
+        a.flags.writeable = False
+        assert not a.flags.writeable
+        with pytest.raises(ValueError):
+            a[0] = 1.0
+        with pytest.raises(ValueError):
+            a += 1
+        a.flags.writeable = True
+        a[0] = 1.0
+        assert float(a[0]) == 1.0
+
+    def test_view_of_readonly_is_readonly(self):
+        a = rt.arange(10).astype(np.float64)
+        a.flags.writeable = False
+        v = a[2:5]
+        assert not v.flags.writeable
+        with pytest.raises(ValueError):
+            v.flags.writeable = True  # reference raises for this case
+        with pytest.raises(ValueError):
+            v += 1
+
+    def test_dict_style_access(self):
+        a = rt.arange(5)
+        assert a.flags["WRITEABLE"]
+        a.flags["WRITEABLE"] = False
+        with pytest.raises(ValueError):
+            a[0] = 1
