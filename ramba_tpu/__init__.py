@@ -52,6 +52,12 @@ from ramba_tpu.ops.manipulation import (  # noqa: F401
     moveaxis, pad, ravel, repeat, reshape, reshape_copy, roll, sort, split,
     squeeze, stack, swapaxes, take, tile, transpose, tril, triu, vstack,
 )
+from ramba_tpu.ops.extras import (  # noqa: F401
+    append, argwhere, bincount, compress, convolve, corrcoef, correlate, cov,
+    cross, delete, diff, digitize, ediff1d, extract, flatnonzero, gradient,
+    histogram, in1d, insert, interp, intersect1d, isin, kron, nan_to_num,
+    nonzero, searchsorted, setdiff1d, union1d, unique, unwrap,
+)
 from ramba_tpu.ops.linalg import (  # noqa: F401
     dot, einsum, inner, matmul, outer, set_matmul_precision, tensordot,
     trace, vdot,

@@ -162,7 +162,7 @@ UNARY = {
         "cosh", "tanh", "arcsinh", "arccosh", "arctanh", "floor", "ceil",
         "trunc", "rint", "isnan", "isinf", "isfinite", "logical_not", "invert",
         "conj", "conjugate", "real", "imag", "degrees", "radians", "deg2rad",
-        "rad2deg", "signbit", "spacing",
+        "rad2deg", "signbit", "spacing", "fabs", "sinc", "i0", "angle",
     ]
     if hasattr(jnp, name)
 }
@@ -176,7 +176,7 @@ BINARY = {
         "logical_and", "logical_or", "logical_xor", "bitwise_and", "bitwise_or",
         "bitwise_xor", "left_shift", "right_shift", "equal", "not_equal",
         "less", "less_equal", "greater", "greater_equal", "copysign",
-        "nextafter", "heaviside",
+        "nextafter", "heaviside", "gcd", "lcm", "ldexp",
     ]
     if hasattr(jnp, name)
 }

@@ -1,0 +1,214 @@
+"""Secondary NumPy API surface beyond the reference's op tables.
+
+The reference exposes only the functions in its make_method tables
+(/root/reference/ramba/ramba.py:7842-7993); a drop-in NumPy user reaches
+for more.  Functions here come in two flavors:
+
+* **static-shape** — lowered lazily through a generic ``jnp_call`` node, so
+  they fuse with surrounding ops in the same flush (diff/gradient/cross/
+  kron/searchsorted/...);
+* **data-dependent-shape** — XLA requires static shapes, so these
+  materialize their inputs and run on host NumPy (unique/nonzero/...), the
+  same boundary the reference draws for driver-side results.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ramba_tpu.core.expr import Node, defop
+from ramba_tpu.core.ndarray import ndarray, as_exprable
+from ramba_tpu.ops.creation import asarray
+
+
+@defop("jnp_call")
+def _op_jnp_call(static, *args):
+    fname, kw = static
+    return getattr(jnp, fname)(*args, **dict(kw))
+
+
+def _lazy(fname, *arrays, **kwargs):
+    kw = tuple(sorted(kwargs.items()))
+    return ndarray(
+        Node("jnp_call", (fname, kw), [as_exprable(a) for a in arrays])
+    )
+
+
+def _host(x):
+    return x.asarray() if isinstance(x, ndarray) else np.asarray(x)
+
+
+# -- static-shape, lazily fused ----------------------------------------------
+
+
+def diff(a, n=1, axis=-1):
+    return _lazy("diff", a, n=int(n), axis=int(axis))
+
+
+def ediff1d(ary):
+    return diff(asarray(ary).reshape(-1))
+
+
+def gradient(f, *varargs, axis=None):
+    if varargs or axis is not None:
+        # spacing arguments / axis selection: host fallback for full numpy
+        # semantics (rare path)
+        out = np.gradient(_host(f), *[_host(v) for v in varargs],
+                          **({"axis": axis} if axis is not None else {}))
+        from ramba_tpu.ops.creation import fromarray
+
+        if isinstance(out, list):
+            return [fromarray(o) for o in out]
+        return fromarray(out)
+    n = asarray(f).ndim
+    if n == 1:
+        return _lazy("gradient", f)
+    # one lazy node per axis; each computes only its own axis
+    return [_lazy("gradient", f, axis=i) for i in range(n)]
+
+
+def cross(a, b, axis=-1):
+    return _lazy("cross", a, b, axis=int(axis))
+
+
+def kron(a, b):
+    return _lazy("kron", a, b)
+
+
+def convolve(a, v, mode="full"):
+    return _lazy("convolve", a, v, mode=mode)
+
+
+def correlate(a, v, mode="valid"):
+    return _lazy("correlate", a, v, mode=mode)
+
+
+def interp(x, xp, fp, left=None, right=None):
+    kw = {}
+    if left is not None:
+        kw["left"] = float(left)
+    if right is not None:
+        kw["right"] = float(right)
+    return _lazy("interp", x, xp, fp, **kw)
+
+
+def unwrap(p, discont=None, axis=-1):
+    kw = {"axis": int(axis)}
+    if discont is not None:
+        kw["discont"] = float(discont)
+    return _lazy("unwrap", p, **kw)
+
+
+def searchsorted(a, v, side="left"):
+    return _lazy("searchsorted", a, v, side=side)
+
+
+def digitize(x, bins, right=False):
+    return _lazy("digitize", x, bins, right=bool(right))
+
+
+def isin(element, test_elements):
+    return _lazy("isin", element, test_elements)
+
+
+def in1d(ar1, ar2):
+    return isin(asarray(ar1).reshape(-1), test_elements=ar2)
+
+
+def bincount(x, weights=None, minlength=0):
+    # length depends on max(x): resolve it (one scalar fetch), then the
+    # count itself is a static-shape segment sum on device
+    n = int(asarray(x).max()) + 1 if asarray(x).size else 0
+    length = max(n, int(minlength))
+    if weights is None:
+        return _lazy("bincount", x, length=length)
+    return _lazy("bincount", x, weights, length=length)
+
+
+def cov(m, y=None, rowvar=True, bias=False, ddof=None):
+    kw = {"rowvar": bool(rowvar), "bias": bool(bias)}
+    if ddof is not None:
+        kw["ddof"] = int(ddof)
+    if y is not None:
+        return _lazy("cov", m, y, **kw)
+    return _lazy("cov", m, **kw)
+
+
+def corrcoef(x, y=None, rowvar=True):
+    if y is not None:
+        return _lazy("corrcoef", x, y, rowvar=bool(rowvar))
+    return _lazy("corrcoef", x, rowvar=bool(rowvar))
+
+
+def append(arr, values, axis=None):
+    from ramba_tpu.ops.manipulation import concatenate
+
+    a, v = asarray(arr), asarray(values)
+    if axis is None:
+        return concatenate([a.reshape(-1), v.reshape(-1)], axis=0)
+    return concatenate([a, v], axis=axis)
+
+
+def nan_to_num(x, copy=True, nan=0.0, posinf=None, neginf=None):
+    kw = {"nan": float(nan)}
+    if posinf is not None:
+        kw["posinf"] = float(posinf)
+    if neginf is not None:
+        kw["neginf"] = float(neginf)
+    return _lazy("nan_to_num", x, **kw)
+
+
+# -- data-dependent shapes: host boundary ------------------------------------
+
+
+def unique(ar, return_index=False, return_inverse=False, return_counts=False):
+    return np.unique(_host(ar), return_index=return_index,
+                     return_inverse=return_inverse,
+                     return_counts=return_counts)
+
+
+def nonzero(a):
+    return np.nonzero(_host(a))
+
+
+def flatnonzero(a):
+    return np.flatnonzero(_host(a))
+
+
+def argwhere(a):
+    return np.argwhere(_host(a))
+
+
+def extract(condition, arr):
+    return np.extract(_host(condition), _host(arr))
+
+
+def compress(condition, a, axis=None):
+    return np.compress(_host(condition), _host(a), axis=axis)
+
+
+def setdiff1d(ar1, ar2):
+    return np.setdiff1d(_host(ar1), _host(ar2))
+
+
+def union1d(ar1, ar2):
+    return np.union1d(_host(ar1), _host(ar2))
+
+
+def intersect1d(ar1, ar2):
+    return np.intersect1d(_host(ar1), _host(ar2))
+
+
+def insert(arr, obj, values, axis=None):
+    return np.insert(_host(arr), obj, _host(values), axis=axis)
+
+
+def delete(arr, obj, axis=None):
+    return np.delete(_host(arr), obj, axis=axis)
+
+
+def histogram(a, bins=10, range=None, weights=None, density=None):
+    w = _host(weights) if weights is not None else None
+    return np.histogram(_host(a), bins=bins, range=range, weights=w,
+                        density=density)

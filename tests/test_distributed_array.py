@@ -1042,3 +1042,120 @@ class TestFlags:
         a.flags["WRITEABLE"] = False
         with pytest.raises(ValueError):
             a[0] = 1
+
+
+class TestExtras:
+    """Secondary NumPy surface (ramba_tpu/ops/extras.py)."""
+
+    def test_lazy_static_shape(self):
+        def f(app):
+            a = app.arange(10).astype(np.float64)
+            b = app.arange(12).reshape(3, 4).astype(np.float64)
+            return (
+                app.diff(a), app.diff(b, axis=0), app.cross(
+                    app.asarray(np.array([1.0, 0, 0])),
+                    app.asarray(np.array([0, 1.0, 0]))),
+                app.kron(app.asarray(np.array([1.0, 2.0])),
+                         app.asarray(np.array([3.0, 4.0]))),
+                app.nan_to_num(app.asarray(np.array([1.0, np.nan, np.inf]))),
+            )
+
+        run_both(f)
+
+    def test_gradient(self):
+        x = np.arange(20.0) ** 2
+        g = rt.gradient(rt.fromarray(x))
+        np.testing.assert_allclose(_to_np(g), np.gradient(x))
+        m = np.arange(12.0).reshape(3, 4)
+        gs = rt.gradient(rt.fromarray(m))
+        es = np.gradient(m)
+        for got, e in zip(gs, es):
+            np.testing.assert_allclose(_to_np(got), e)
+
+    def test_searchsorted_digitize_isin(self):
+        def f(app):
+            a = app.asarray(np.array([1.0, 3.0, 5.0, 7.0]))
+            v = app.asarray(np.array([2.0, 6.0]))
+            return (app.searchsorted(a, v),
+                    app.digitize(v, np.array([0.0, 4.0, 8.0])),
+                    app.isin(app.arange(6), np.array([1, 4])))
+
+        run_both(f)
+
+    def test_bincount(self):
+        x = np.array([0, 1, 1, 3, 2, 1])
+
+        def f(app):
+            return app.bincount(app.asarray(x)), app.bincount(
+                app.asarray(x), minlength=8)
+
+        run_both(f)
+
+    def test_cov_corrcoef(self):
+        m = np.random.RandomState(0).rand(3, 8)
+
+        def f(app):
+            return app.cov(app.asarray(m)), app.corrcoef(app.asarray(m))
+
+        run_both(f, rtol=1e-8)
+
+    def test_convolve_interp(self):
+        def f(app):
+            a = app.asarray(np.array([1.0, 2.0, 3.0]))
+            v = app.asarray(np.array([0.0, 1.0, 0.5]))
+            x = app.asarray(np.array([1.5, 2.5]))
+            xp = app.asarray(np.array([1.0, 2.0, 3.0]))
+            fp = app.asarray(np.array([3.0, 2.0, 0.0]))
+            return app.convolve(a, v), app.interp(x, xp, fp)
+
+        run_both(f)
+
+    def test_host_boundary_ops(self):
+        x = np.array([3, 1, 2, 3, 0, 1])
+        np.testing.assert_array_equal(rt.unique(rt.fromarray(x)), np.unique(x))
+        np.testing.assert_array_equal(
+            rt.nonzero(rt.fromarray(x))[0], np.nonzero(x)[0])
+        np.testing.assert_array_equal(
+            rt.setdiff1d(rt.fromarray(x), np.array([1, 3])),
+            np.setdiff1d(x, [1, 3]))
+        h, edges = rt.histogram(rt.fromarray(x.astype(float)), bins=4)
+        eh, ee = np.histogram(x.astype(float), bins=4)
+        np.testing.assert_array_equal(h, eh)
+        np.testing.assert_allclose(edges, ee)
+
+    def test_append(self):
+        def f(app):
+            a = app.arange(6).reshape(2, 3)
+            return (app.append(a, app.ones((1, 3), dtype=a.dtype), axis=0),
+                    app.append(app.arange(3), app.arange(2)))
+
+        run_both(f)
+
+    def test_extra_ufuncs(self):
+        def f(app):
+            a = app.arange(1, 7)
+            return (app.gcd(a, app.full_like(a, 4)),
+                    app.lcm(a, app.full_like(a, 3)),
+                    app.fabs(app.arange(-3.0, 3.0)),
+                    app.sinc(app.arange(5).astype(np.float64) / 7))
+
+        run_both(f, rtol=1e-8)
+
+
+class TestExtrasReviewFixes:
+    def test_interp_left_right(self):
+        x = np.array([-1.0, 5.0])
+        xp, fp = np.array([0.0, 1.0]), np.array([10.0, 20.0])
+        got = rt.interp(rt.fromarray(x), xp, fp, left=-7.0, right=99.0)
+        np.testing.assert_allclose(_to_np(got),
+                                   np.interp(x, xp, fp, left=-7.0, right=99.0))
+
+    def test_argwhere_exported(self):
+        x = np.array([0, 3, 0, 5])
+        np.testing.assert_array_equal(rt.argwhere(rt.fromarray(x)),
+                                      np.argwhere(x))
+
+    def test_nan_to_num_kwargs(self):
+        x = np.array([np.nan, np.inf, -np.inf])
+        got = rt.nan_to_num(rt.fromarray(x), nan=1.0, posinf=2.0, neginf=-2.0)
+        np.testing.assert_allclose(_to_np(got), [1.0, 2.0, -2.0])
