@@ -119,7 +119,10 @@ def in1d(ar1, ar2):
 def bincount(x, weights=None, minlength=0):
     # length depends on max(x): resolve it (one scalar fetch), then the
     # count itself is a static-shape segment sum on device
-    n = int(asarray(x).max()) + 1 if asarray(x).size else 0
+    xa = asarray(x)
+    if xa.size and int(xa.min()) < 0:
+        raise ValueError("'x' argument must not be negative")
+    n = int(xa.max()) + 1 if xa.size else 0
     length = max(n, int(minlength))
     if weights is None:
         return _lazy("bincount", x, length=length)

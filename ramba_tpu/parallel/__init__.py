@@ -1,1 +1,4 @@
-"""ramba_tpu.parallel subpackage."""
+"""ramba_tpu.parallel subpackage: mesh/partitioning, shard metadata,
+distribution constraints, multi-host bring-up."""
+
+from ramba_tpu.parallel import shardview  # noqa: F401

@@ -1,0 +1,105 @@
+"""Shard/distribution metadata queries.
+
+Reference: /root/reference/ramba/shardview_array.py — the packed int32
+shardview encoding (row0=size, row1=global index_start, ...) and its algebra
+(mapslice/intersect/broadcast/...), plus ``find_owning_worker``
+(/root/reference/ramba/common.py:287-680 area).
+
+TPU-native design: XLA owns memory layout, so the *algebra* (slicing,
+intersection, broadcasting of views) disappears into GSPMD; what remains
+genuinely useful is the *query* surface — where does each shard of an array
+live in global index space?  That is derived here from the array's
+``NamedSharding`` rather than maintained by hand, so it can never go stale.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ramba_tpu.parallel import mesh as _mesh
+
+
+def _concrete(a):
+    from ramba_tpu.core.ndarray import ndarray
+
+    return a._value() if isinstance(a, ndarray) else a
+
+
+def _all_shard_indices(v):
+    """(device, index-tuple) for EVERY shard, including remote-host ones —
+    addressable_shards alone would make multi-host queries partial."""
+    return list(v.sharding.devices_indices_map(v.shape).items())
+
+
+def shard_slices(a) -> list:
+    """Per-device global index ranges, one tuple of slices per addressable
+    shard (reference: the per-worker shardview rows size/index_start,
+    shardview_array.py:32-70)."""
+    v = _concrete(a)
+    return [s.index for s in v.addressable_shards]
+
+
+def divisions(a) -> np.ndarray:
+    """Reference-style (n_shards, 2, ndim) start/end table
+    (reference: divisions_to_distribution / distribution_to_divisions,
+    shardview_array.py:617-935)."""
+    v = _concrete(a)
+    nd = len(v.shape)
+    out = []
+    for s in v.addressable_shards:
+        idx = s.index
+        starts = [
+            (sl.start if sl.start is not None else 0) for sl in idx
+        ] + [0] * (nd - len(idx))
+        ends = [
+            (sl.stop if sl.stop is not None else dim)
+            for sl, dim in zip(idx, v.shape)
+        ] + list(v.shape[len(idx):])
+        out.append([starts, ends])
+    return np.asarray(out, dtype=np.int64)
+
+
+def find_owning_worker(a, index) -> int:
+    """Which worker (global device ordinal in the mesh) owns global
+    ``index`` (reference: find_owning_worker, common.py:653-680).  Covers
+    remote-host shards on multi-host meshes."""
+    v = _concrete(a)
+    index = tuple(int(i) for i in (
+        index if isinstance(index, (tuple, list)) else (index,)
+    ))
+    mesh_devs = list(_mesh.get_mesh().devices.flat)
+    for dev, idx in _all_shard_indices(v):
+        ok = True
+        for d, i in enumerate(index):
+            sl = idx[d] if d < len(idx) else slice(None)
+            lo = sl.start if sl.start is not None else 0
+            hi = sl.stop if sl.stop is not None else v.shape[d]
+            if not (lo <= i < hi):
+                ok = False
+                break
+        if ok:
+            try:
+                return mesh_devs.index(dev)
+            except ValueError:
+                return int(getattr(dev, "id", 0))
+    raise IndexError(f"index {index} out of bounds for shape {v.shape}")
+
+
+def default_distribution(shape) -> np.ndarray:
+    """Division table the default partitioner would choose for ``shape``
+    (reference: default_distribution, shardview_array.py:907-935).  Pure
+    metadata — no device allocation."""
+    from jax.sharding import NamedSharding
+
+    shape = tuple(int(s) for s in shape)
+    mesh = _mesh.get_mesh()
+    sh = NamedSharding(mesh, _mesh.default_spec(shape, mesh))
+    out = []
+    for _dev, idx in sh.devices_indices_map(shape).items():
+        starts = [(sl.start if sl.start is not None else 0) for sl in idx]
+        ends = [
+            (sl.stop if sl.stop is not None else dim)
+            for sl, dim in zip(idx, shape)
+        ]
+        out.append([starts, ends])
+    return np.asarray(out, dtype=np.int64)

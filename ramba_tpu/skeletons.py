@@ -542,17 +542,29 @@ class LocalView:
     """Per-worker view of a distributed array inside ``spmd`` (reference:
     LocalNdarray with get_local, ramba.py:1169-1357, docs/index.md:247-266).
     ``set_local`` is the functional replacement for in-place shard mutation:
-    the updated block is written back to the source array after the call."""
+    the updated block is written back to the source array after the call.
+    ``global_start`` gives this shard's offset in global index space (the
+    reference's per-shard ``subspace`` shardview row index_start,
+    shardview_array.py:32-70)."""
 
-    def __init__(self, block):
+    def __init__(self, block, global_start=None):
         self._block = block
         self._updated = None
+        self._global_start = global_start
 
     def get_local(self):
         return self._block if self._updated is None else self._updated
 
     def set_local(self, value):
         self._updated = jnp.asarray(value, self._block.dtype)
+
+    @property
+    def global_start(self):
+        """Per-dim global index of this shard's [0,...,0] element (traced
+        int32 scalars, usable inside the spmd kernel)."""
+        if self._global_start is None:
+            raise ValueError("global_start is only available inside spmd")
+        return self._global_start
 
     @property
     def shape(self):
@@ -612,8 +624,26 @@ def spmd(func, *args):
         jax.device_put(v, NamedSharding(mesh, s)) for v, s in zip(vals, specs)
     ]
 
+    def _starts(spec, block_shape):
+        """Global offset of this device's block per dim, from mesh coords
+        (reference: per-shard index_start, shardview_array.py:32-70)."""
+        out = []
+        for d, entry in enumerate(spec):
+            if entry is None:
+                out.append(jnp.zeros((), jnp.int32))
+                continue
+            names = (entry,) if isinstance(entry, str) else tuple(entry)
+            pos = jnp.zeros((), jnp.int32)
+            for nm in names:
+                pos = pos * mesh.shape[nm] + jax.lax.axis_index(nm)
+            out.append(pos * block_shape[d])
+        out += [jnp.zeros((), jnp.int32)] * (len(block_shape) - len(out))
+        return tuple(out)
+
     def inner(*blocks):
-        views = [LocalView(b) for b in blocks]
+        views = [
+            LocalView(b, _starts(s, b.shape)) for b, s in zip(blocks, specs)
+        ]
         call_args = list(args)
         for p, v in zip(arr_positions, views):
             call_args[p] = v

@@ -431,3 +431,54 @@ class TestGroupbyVariations:
              x[:, [4, 5]].sum(axis=1)], axis=1
         )
         np.testing.assert_allclose(got, expected)
+
+
+class TestShardview:
+    """Shard-metadata queries (reference: shardview_array.py encoding,
+    find_owning_worker common.py:653-680)."""
+
+    def test_shard_slices_and_divisions(self):
+        from ramba_tpu.parallel import shardview
+
+        a = rt.zeros((1024, 8), distribution=(8, 1))
+        sl = shardview.shard_slices(a)
+        assert len(sl) == 8
+        div = shardview.divisions(a)
+        assert div.shape == (8, 2, 2)
+        # blocks tile the row space exactly
+        starts = sorted(int(d[0][0]) for d in div)
+        assert starts == [i * 128 for i in range(8)]
+        assert all(int(d[1][1]) == 8 for d in div)
+
+    def test_find_owning_worker(self):
+        from ramba_tpu.parallel import shardview
+
+        a = rt.zeros((1024,), distribution=(8,))
+        w0 = shardview.find_owning_worker(a, 0)
+        w_last = shardview.find_owning_worker(a, 1023)
+        assert w0 != w_last
+        with pytest.raises(IndexError):
+            shardview.find_owning_worker(a, 5000)
+
+    def test_default_distribution(self):
+        from ramba_tpu.parallel import shardview
+
+        div = shardview.default_distribution((4096,))
+        assert div.shape[0] == 8
+
+    def test_spmd_global_start(self):
+        # each worker writes its global row offset into its block
+        x = rt.zeros((1024,))
+
+        def kern(v):
+            import jax.numpy as jnp
+
+            start = v.global_start[0]
+            blk = v.get_local()
+            v.set_local(jnp.full(blk.shape, start, blk.dtype))
+
+        rt.spmd(kern, x)
+        got = x.asarray()
+        # every element equals its block's global start: 0,...,128,...,896
+        expect = (np.arange(1024) // 128) * 128
+        np.testing.assert_allclose(got, expect)
