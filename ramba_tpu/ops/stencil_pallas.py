@@ -40,15 +40,13 @@ _ENABLED = os.environ.get("RAMBA_TPU_PALLAS", "1") not in ("0", "")
 _VMEM_BUDGET = 8 << 20
 
 
-def available(arrs) -> bool:
-    """Pallas path eligibility for this op instance."""
+def available_local(arrs) -> bool:
+    """Kernel eligibility for already-local (per-shard) blocks — used from
+    inside stencil_sharded's shard_map, where halo exchange has happened
+    and the pallas_call sees purely local data."""
     if not _ENABLED:
         return False
     if not (_INTERPRET or jax.default_backend() == "tpu"):
-        return False
-    if len(jax.devices()) != 1 and not _INTERPRET:
-        # sharded inputs would be all-gathered around the pallas_call;
-        # keep GSPMD's halo exchange instead
         return False
     shapes = {a.shape for a in arrs}
     if len(shapes) != 1:
@@ -60,6 +58,16 @@ def available(arrs) -> bool:
     dtypes = {a.dtype for a in arrs}
     return len(dtypes) == 1 and dtypes <= {jnp.dtype(jnp.float32),
                                            jnp.dtype(jnp.bfloat16)}
+
+
+def available(arrs) -> bool:
+    """Pallas path eligibility for this op instance (global arrays)."""
+    if len(jax.devices()) != 1 and not _INTERPRET:
+        # sharded inputs would be all-gathered around the pallas_call;
+        # multi-device goes through stencil_sharded (explicit ppermute
+        # halos feeding the kernel on local blocks)
+        return False
+    return available_local(arrs)
 
 
 def _round_up(x: int, m: int) -> int:
@@ -166,8 +174,14 @@ def _run_fast(func, lo, hi, slots, arrs, taps):
                             slabs[k].at[b, pl.ds(0, L), cds],
                             sems.at[b, k],
                         )
+                    # bh ≡ 0 (mod 8) and _RM == 8, so j*bh - _RM is 8-aligned;
+                    # phrase it as (…)*8 + pl.multiple_of so Mosaic's prover
+                    # accepts the sublane-tiled HBM slice (BENCH_r02 failure:
+                    # "tile index in dimension 0 … divisible by the tiling
+                    # (8)" at bh=40 on the 8192x8192 bench shape).
+                    rs_mid = pl.multiple_of((j * (bh // 8) - 1) * 8, 8)
                     return pltpu.make_async_copy(
-                        ins[k].at[pl.ds(j * bh - _RM, slab_h)],
+                        ins[k].at[pl.ds(rs_mid, slab_h)],
                         slabs[k].at[b, pl.ds(0, slab_h), cds],
                         sems.at[b, k],
                     )
@@ -306,8 +320,11 @@ def _run_padded(func, lo, hi, slots, arrs, taps=8):
         sem = refs[-1]
         i = pl.program_id(0)
         for k in range(n_slabs):
+            # bh is a static multiple of 8: expose that to Mosaic's
+            # divisibility prover (same class of failure as BENCH_r02)
+            rs = pl.multiple_of(i * (bh // 8) * 8, 8)
             cp = pltpu.make_async_copy(
-                ins[k].at[pl.ds(i * bh, slab_h), :], slabs[k], sem
+                ins[k].at[pl.ds(rs, slab_h), :], slabs[k], sem
             )
             cp.start()
             cp.wait()

@@ -419,22 +419,10 @@ def stencil(func=None, **kwargs):
 _pallas_fallback_warned = False
 
 
-def _eval_stencil(static, *arrs):
-    func, lo, hi, slots, taps = static
-    if len(arrs[0].shape) == 2:
-        from ramba_tpu.ops import stencil_pallas
-
-        if stencil_pallas.available(arrs):
-            try:
-                return stencil_pallas.run(func, lo, hi, slots, arrs, taps)
-            except Exception as e:  # fall back to the XLA path, but say so
-                global _pallas_fallback_warned
-                if not _pallas_fallback_warned:
-                    _pallas_fallback_warned = True
-                    warnings.warn(
-                        f"pallas stencil kernel unavailable, using XLA "
-                        f"shifted-slice path: {type(e).__name__}: {e}"
-                    )
+def stencil_interior(func, lo, hi, slots, arrs):
+    """Evaluate the stencil body over the interior window of ``arrs`` via
+    shifted static slices; returns the raw interior values (shape = arr
+    shape minus the neighborhood extent), no border zeroing."""
     shape = arrs[0].shape
     interior = tuple(
         s - (h - l) for s, l, h in zip(shape, lo, hi)
@@ -453,7 +441,40 @@ def _eval_stencil(static, *arrs):
         val = func(*build_args(False))
     except (jax.errors.TracerArrayConversionError, TypeError):
         val = _unwrap(func(*build_args(True)))
-    val = _unwrap(val)
+    return _unwrap(val)
+
+
+def _eval_stencil(static, *arrs):
+    global _pallas_fallback_warned
+    func, lo, hi, slots, taps = static
+    if len(arrs[0].shape) == 2:
+        from ramba_tpu.ops import stencil_pallas, stencil_sharded
+
+        if stencil_sharded.eligible(lo, hi, arrs):
+            try:
+                return stencil_sharded.run(func, lo, hi, slots, arrs, taps)
+            except Exception as e:  # same fence as the pallas path below
+                if not _pallas_fallback_warned:
+                    _pallas_fallback_warned = True
+                    warnings.warn(
+                        f"sharded stencil path unavailable, using GSPMD "
+                        f"shifted-slice path: {type(e).__name__}: {e}"
+                    )
+        if stencil_pallas.available(arrs):
+            try:
+                return stencil_pallas.run(func, lo, hi, slots, arrs, taps)
+            except Exception as e:  # fall back to the XLA path, but say so
+                if not _pallas_fallback_warned:
+                    _pallas_fallback_warned = True
+                    warnings.warn(
+                        f"pallas stencil kernel unavailable, using XLA "
+                        f"shifted-slice path: {type(e).__name__}: {e}"
+                    )
+    shape = arrs[0].shape
+    interior = tuple(
+        s - (h - l) for s, l, h in zip(shape, lo, hi)
+    )
+    val = stencil_interior(func, lo, hi, slots, arrs)
     out = jnp.zeros(shape, val.dtype)
     idx = tuple(slice(-l, -l + n) for l, n in zip(lo, interior))
     return out.at[idx].set(val)

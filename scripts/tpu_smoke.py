@@ -1,0 +1,66 @@
+"""Hardware smoke test for the Pallas stencil fast path.
+
+Round-2 postmortem: the fast kernel was only ever exercised in interpret
+mode, so a Mosaic compile failure ("tile index in dimension 0 … divisible
+by the tiling (8)" at the 8192x8192 bench shape) survived two rounds of
+green tests.  This script compiles and runs the kernel on the real chip at
+the shapes that matter — including the exact bench shape — and checks
+numerics against the XLA shifted-slice path.
+
+Run directly (exit code 0 = all shapes pass), or import `smoke()` from
+bench.py as a pre-flight gate.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def smoke(shapes=((1024, 1024), (8192, 8192)), verbose=True) -> list:
+    """Compile + run the stencil fast path at each shape; return failures."""
+    import jax
+
+    import ramba_tpu as rt
+    from ramba_tpu.ops import stencil_pallas
+
+    @rt.stencil
+    def star2(a):
+        return (
+            0.25 * (a[0, 1] + a[0, -1] + a[1, 0] + a[-1, 0])
+            + 0.125 * (a[0, 2] + a[0, -2] + a[2, 0] + a[-2, 0])
+        )
+
+    failures = []
+    for shape in shapes:
+        try:
+            rng = np.random.RandomState(0)
+            xa = rng.rand(*shape).astype(np.float32)
+            x = rt.fromarray(xa)
+            y = rt.sstencil(star2, x)
+            got = np.asarray(y)
+            # spot-check numerics on a small patch against pure NumPy
+            r, c = 4, 4
+            want = (
+                0.25 * (xa[r, c + 1] + xa[r, c - 1] + xa[r + 1, c] + xa[r - 1, c])
+                + 0.125 * (xa[r, c + 2] + xa[r, c - 2] + xa[r + 2, c] + xa[r - 2, c])
+            )
+            assert abs(got[r, c] - want) < 1e-4, (got[r, c], want)
+            assert np.all(got[:2, :] == 0) and np.all(got[:, :2] == 0)
+            if verbose:
+                print(f"smoke {shape}: ok (pallas_used="
+                      f"{stencil_pallas.available([x._value()])})")
+        except Exception as e:  # noqa: BLE001 - report, don't die
+            failures.append((shape, repr(e)))
+            if verbose:
+                print(f"smoke {shape}: FAIL {e!r}", file=sys.stderr)
+    return failures
+
+
+if __name__ == "__main__":
+    fails = smoke()
+    sys.exit(1 if fails else 0)

@@ -13,6 +13,11 @@ from ramba_tpu.ops import stencil_pallas
 def interpret_mode(monkeypatch):
     monkeypatch.setattr(stencil_pallas, "_INTERPRET", True)
     monkeypatch.setattr(stencil_pallas, "_ENABLED", True)
+    # pin dispatch to the single-chip kernel: the multi-device composed
+    # path (shard_map + ppermute + local kernel) has its own test file
+    from ramba_tpu.ops import stencil_sharded
+
+    monkeypatch.setattr(stencil_sharded, "eligible", lambda *a, **k: False)
 
 
 def _prk_star2(w=None):
