@@ -53,7 +53,7 @@ dist_threshold = _env_int("RAMBA_DIST_THRESHOLD", 100)
 max_pending_ops = _env_int("RAMBA_TPU_MAX_PENDING", 10_000)
 
 # How many mesh axes the default mesh is factored into (1..3).
-mesh_ndim = _env_int("RAMBA_TPU_MESH_NDIM", 1)
+mesh_ndim = _env_int("RAMBA_TPU_MESH_NDIM", 2)
 
 # Pattern-rewrite rules on the lazy graph (reference: DAG rewrites,
 # ramba.py:4567-4789; always on there — gated here for debugging).

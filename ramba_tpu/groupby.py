@@ -28,6 +28,19 @@ from ramba_tpu.ops.creation import asarray
 def _op_segment_reduce(static, x, labels):
     kind, num_groups, dim = static
     x = jnp.moveaxis(x, dim, 0)
+    # GSPMD miscompiles scatter-adds whose segment axis is sharded on a
+    # multi-axis mesh (verified: segment_sum over a P('d1','d0')-sharded
+    # operand returns wrong partial sums).  Pin the segment axis unsharded
+    # — the scatter needs those rows gathered anyway — and leave the other
+    # dims to the partitioner.
+    from jax.sharding import NamedSharding, PartitionSpec as _P
+
+    from ramba_tpu.parallel import mesh as _mesh
+
+    mesh = _mesh.get_mesh()
+    if mesh.devices.size > 1:
+        spec = _P(None, *([_P.UNCONSTRAINED] * (x.ndim - 1)))
+        x = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
     if kind in ("nansum", "nanmean", "nanvar", "nanstd"):
         valid = ~jnp.isnan(x)
         data = jnp.where(valid, x, 0)

@@ -129,17 +129,43 @@ def compute_regular_schedule(shape: tuple, n: int) -> tuple:
     return best if best is not None else (1,) * ndim
 
 
+def _spec_parallelism(spec: P, mesh: Mesh) -> int:
+    total = 1
+    for e in spec:
+        if e is None:
+            continue
+        for nm in (e,) if isinstance(e, str) else e:
+            total *= mesh.shape[nm]
+    return total
+
+
 def default_spec(shape: Sequence[int], mesh: Optional[Mesh] = None) -> P:
     """Pick a PartitionSpec for a new array of ``shape``.
 
     Small arrays are replicated (reference: do_not_distribute,
-    /root/reference/ramba/common.py:217-218).  Otherwise mesh axes are greedily
-    assigned to the largest array dims that they divide into usefully.
+    /root/reference/ramba/common.py:217-218).  Otherwise the
+    surface-minimizing partition solver chooses per-dimension split counts
+    (the reference's compute_regular_schedule, common.py:287-680) and the
+    splits are realized on mesh axes; when the mesh's factorization cannot
+    realize the solver's choice at full parallelism, fall back to the
+    greedy largest-dim assignment.
     """
     mesh = mesh or get_mesh()
     shape = tuple(int(s) for s in shape)
     if len(shape) == 0 or math.prod(shape) < common.dist_threshold:
         return P()
+    n = mesh.devices.size
+    solved = spec_from_splits(compute_regular_schedule(shape, n), mesh)
+    if _spec_parallelism(solved, mesh) == n:
+        return solved
+    greedy = _greedy_spec(shape, mesh)
+    if _spec_parallelism(greedy, mesh) > _spec_parallelism(solved, mesh):
+        return greedy
+    return solved
+
+
+def _greedy_spec(shape: tuple, mesh: Mesh) -> P:
+    """Largest-axis-to-largest-dim assignment (pre-solver behavior)."""
     axes = sorted(mesh.shape.items(), key=lambda kv: -kv[1])  # (name, size)
     dims_by_size = sorted(range(len(shape)), key=lambda d: -shape[d])
     assignment: dict[int, list] = {}

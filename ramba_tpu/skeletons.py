@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ramba_tpu import common
 from ramba_tpu.core.expr import Const, Node, defop
 from ramba_tpu.core.fuser import sync as _sync
 from ramba_tpu.core.ndarray import ndarray
@@ -510,46 +511,117 @@ def sstencil(st, arr, *args):
 # ---------------------------------------------------------------------------
 
 
+def _probe_associative(local_func, final_func) -> bool:
+    """Decide whether the scan can lower to ``lax.associative_scan``.
+
+    Host-side probe with concrete floats (the reference decides the carry
+    protocol per-op by construction; here the user's pair of functions is
+    opaque, so associativity is tested numerically):
+
+    * combine(a, b) := local_func(b, a) must be associative, and
+    * final_func(c, t) must equal combine(c, t) (the cross-block carry
+      application must be the same op).
+
+    Any exception (e.g. a kernel that only accepts arrays) or mismatch
+    falls back to the sequential path — detection can only upgrade.
+    """
+    try:
+        rng = np.random.RandomState(7)
+        trips = rng.uniform(0.25, 2.0, size=(8, 3)).astype(np.float64)
+
+        def comb(a, b):
+            return float(local_func(np.float64(b), np.float64(a)))
+
+        for a, b, c in trips:
+            if not np.isclose(comb(comb(a, b), c), comb(a, comb(b, c)),
+                              rtol=1e-9, atol=1e-12):
+                return False
+            if not np.isclose(float(final_func(np.float64(a), np.float64(b))),
+                              comb(a, b), rtol=1e-9, atol=1e-12):
+                return False
+        return True
+    except Exception:
+        return False
+
+
 @defop("scumulative")
 def _op_scumulative(static, x):
-    local_func, final_func, nblocks = static
+    local_func, final_func, associative = static
     n = x.shape[0]
-    block = max(1, -(-n // nblocks))
-    nb = -(-n // block)
+    mesh = _mesh.get_mesh()
+    axes = tuple(mesh.axis_names)
+    nsh = int(np.prod([mesh.shape[a] for a in axes]))
 
     def local_scan(b):
+        if associative:
+            # log-depth vectorized scan on the VPU — the TPU-native
+            # replacement for the reference's per-element Numba loop
+            return jax.lax.associative_scan(
+                lambda a, c: _call_kernel(local_func, c, a), b
+            )
+
         def step(carry, xi):
             y = jnp.where(carry[1], _call_kernel(local_func, xi, carry[0]), xi)
             return (y, jnp.asarray(True)), y
 
-        (_, _), ys = jax.lax.scan(step, (jnp.zeros((), x.dtype), jnp.asarray(False)), b)
+        (_, _), ys = jax.lax.scan(
+            step, (jnp.zeros((), x.dtype), jnp.asarray(False)), b
+        )
         return ys
 
-    outs = []
-    prev_last = None
-    for i in range(nb):
-        piece = x[i * block: min((i + 1) * block, n)]
-        local = local_scan(piece)
-        if prev_last is None:
-            fixed = local
-        else:
-            fixed = _call_kernel(final_func, prev_last, local)
-        prev_last = fixed[-1]
-        outs.append(fixed)
-    return jnp.concatenate(outs, 0)
+    if nsh == 1 or n < max(nsh * 2, common.dist_threshold):
+        return local_scan(x)
+
+    # Distributed: per-shard scan under shard_map, then a cross-shard carry
+    # fix-up.  The reference chains carries worker-to-worker sequentially
+    # over its comm queues (ramba.py:3378-3437); here each shard all-gathers
+    # the per-shard totals (nsh scalars — one tiny collective) and folds its
+    # own exclusive carry locally, so the only cross-shard dependency is one
+    # all-gather instead of an nsh-deep message chain.
+    pad = (-n) % nsh
+    xp = jnp.pad(x, (0, pad)) if pad else x
+
+    def per_shard(b):
+        ys = local_scan(b)
+        t = ys[-1]
+        idx = jax.lax.axis_index(axes)
+        ts = jax.lax.all_gather(t, axes, tiled=False)
+
+        def fold(c, args):
+            j, tj = args
+            nc = jnp.where(j == 0, tj, _call_kernel(final_func, c, tj))
+            return nc, c  # emit the carry BEFORE tj: exclusive prefix
+
+        _, excl = jax.lax.scan(
+            fold, jnp.zeros((), ys.dtype), (jnp.arange(nsh), ts)
+        )
+        carry = excl[idx]
+        fixed = _call_kernel(final_func, carry, ys)
+        return jnp.where(idx == 0, ys, fixed)
+
+    out = jax.shard_map(
+        per_shard, mesh=mesh, in_specs=P(axes), out_specs=P(axes),
+        check_vma=False,
+    )(xp)
+    return out[:n] if pad else out
 
 
-def scumulative(local_func, final_func, arr):
+def scumulative(local_func, final_func, arr, associative=None):
     """Reference: ramba.scumulative (docs/index.md:219-243,
-    ramba.py:10057-10115,3378-3437).  Blocks scan in parallel; the
-    carry chain across blocks is unrolled inside one compiled program
-    (nblocks = worker count, matching the reference's per-worker split)."""
+    ramba.py:10057-10115,3378-3437).
+
+    ``associative=True`` (or a successful host-side probe when None, the
+    default) lowers the per-shard scan to ``lax.associative_scan``;
+    ``associative=False`` forces the sequential ``lax.scan`` element chain.
+    Either way blocks scan in parallel per shard and the cross-shard carry
+    is fixed up with one totals all-gather inside the same program."""
     arr = asarray(arr)
     if arr.ndim != 1:
         raise ValueError("scumulative requires a 1-D array")
-    nblocks = _mesh.num_workers()
+    if associative is None:
+        associative = _probe_associative(local_func, final_func)
     return ndarray(
-        Node("scumulative", (local_func, final_func, nblocks),
+        Node("scumulative", (local_func, final_func, bool(associative)),
              [arr.read_expr()])
     )
 

@@ -982,20 +982,24 @@ class TestDistributionArgument:
     def test_creation_with_distribution(self):
         from jax.sharding import PartitionSpec as P
 
+        from ramba_tpu.parallel import mesh as _mesh
+
         n = 1024
+        d0 = _mesh.get_mesh().shape["d0"]
         for make in (
             lambda d: rt.zeros((n, 8), distribution=d),
             lambda d: rt.ones((n, 8), distribution=d),
             lambda d: rt.full((n, 8), 3.0, distribution=d),
             lambda d: rt.fromfunction(lambda i, j: i + j, (n, 8), distribution=d),
         ):
-            for dist in ((8, 1), P("d0"),):
+            # (8, 1): explicit split counts -> realized with whatever mesh
+            # axes multiply to 8; P("d0"): raw spec -> d0-way split
+            for dist, rows in (((8, 1), n // 8), (P("d0"), n // d0)):
                 a = make(dist)
                 assert a.shape == (n, 8)
                 v = a._value()
                 assert len(v.addressable_shards) == 8
-                # 8-way split along dim 0 -> each shard has n/8 rows
-                assert v.addressable_shards[0].data.shape[0] == n // 8
+                assert v.addressable_shards[0].data.shape[0] == rows
 
     def test_arange_linspace_distribution(self):
         a = rt.arange(4096, distribution=(8,))

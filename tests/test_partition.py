@@ -1,0 +1,120 @@
+"""Partition solver in the live layout path.
+
+The reference chooses per-dimension split counts that minimize inter-shard
+surface area (compute_regular_schedule, /root/reference/ramba/common.py:
+287-680) and every created array gets that layout.  Here the same solver
+drives ``default_spec`` on the (4, 2) two-axis default mesh, so 2-D arrays
+get surface-minimizing 2-D splits instead of maximal-surface 1-D ones.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import ramba_tpu as rt
+from ramba_tpu.parallel.mesh import (
+    compute_regular_schedule,
+    default_spec,
+    get_mesh,
+)
+
+
+class TestSolver:
+    @pytest.mark.parametrize(
+        "shape,n,want",
+        [
+            # square 2-D: balanced split minimizes cut surface
+            ((8192, 8192), 8, (4, 2)),
+            ((8192, 8192), 4, (2, 2)),
+            ((8192, 8192), 16, (4, 4)),
+            # skewed: cut the long dim more
+            ((100000, 10), 8, (8, 1)),
+            ((10, 100000), 8, (1, 8)),
+            # 1-D: all splits on the only dim
+            ((1 << 20,), 8, (8,)),
+            # 3-D cube
+            ((64, 64, 64), 8, (2, 2, 2)),
+            # short first dim: every cut of the long dim costs only 4
+            ((4, 100000), 8, (1, 8)),
+        ],
+    )
+    def test_split_choices(self, shape, n, want):
+        got = compute_regular_schedule(shape, n)
+        # accept permutations that tie on cost for square shapes
+        if sorted(got) == sorted(want) and shape[0] == shape[-1]:
+            return
+        assert got == want, (shape, n, got)
+
+    def test_default_spec_uses_solver(self):
+        mesh = get_mesh()
+        if mesh.devices.size != 8 or len(mesh.axis_names) < 2:
+            pytest.skip("needs the default (4,2) test mesh")
+        # 2-D square array: both mesh axes used, one per dim
+        spec = default_spec((1024, 1024))
+        entries = tuple(spec)
+        used = [e for e in entries if e is not None]
+        assert len(used) == 2, spec
+        # 1-D array: full 8-way split via both axes stacked
+        spec1 = default_spec((1 << 16,))
+        (e,) = tuple(spec1)
+        names = (e,) if isinstance(e, str) else tuple(e)
+        assert int(np.prod([mesh.shape[a] for a in names])) == 8
+
+    def test_small_arrays_replicated(self):
+        assert default_spec((4, 4)) == P()
+
+
+class TestTwoDMeshRegressions:
+    def test_groupby_on_2d_sharded_view(self):
+        """segment reductions were silently wrong when the segment axis was
+        sharded on a multi-axis mesh (GSPMD scatter-add miscompile); pinned
+        unsharded in _op_segment_reduce."""
+        x = np.arange(120.0).reshape(10, 12)
+        r = rt.fromarray(x)[2:9, 1:11].T
+        xs = x[2:9, 1:11].T
+        labels = (np.arange(7) * 2) % 4
+        gb = r.groupby(1, labels, num_groups=4)
+        got = gb.sum().asarray()
+        want = np.stack(
+            [xs[:, labels == g].sum(axis=1) if (labels == g).any()
+             else np.zeros(10) for g in range(4)],
+            axis=1,
+        )
+        np.testing.assert_allclose(got, want)
+
+    def test_stencil_halo_traffic_smaller_on_2d_split(self):
+        """A (4,2) 2-D split of a square stencil operand moves less halo
+        than a 1-D 8-way split: per-iteration ppermute bytes shrink from
+        2*W*r rows-only-but-7-cuts to the 2-D surface."""
+        from ramba_tpu.ops import stencil_sharded
+
+        @rt.stencil
+        def five(a):
+            return a[0, 0] + 0.25 * (
+                a[-1, 0] + a[1, 0] + a[0, -1] + a[0, 1]
+            )
+
+        n = 256
+        x = jnp.zeros((n, n), jnp.float32)
+
+        def step(v):
+            return stencil_sharded.run(
+                five.func, (-1, -1), (1, 1), (("arr", 0),), [v], 5
+            )
+
+        hlo = jax.jit(step).lower(x).compile().as_text()
+        import re
+
+        halo_elems = 0
+        for m in re.finditer(
+            r"f32\[(\d+),(\d+)\][^\n]*collective-permute", hlo
+        ):
+            halo_elems += int(m.group(1)) * int(m.group(2))
+        # 2-D (4,2) split of 256x256 with radius 1: per-shard halos are
+        # column slivers (64,1) and row slivers (1,~130) — a few hundred
+        # elements.  A 1-D 8-way split would move full 256-wide rows
+        # (>=512 elements per shard pair).  Assert the 2-D regime.
+        assert 0 < halo_elems < 512, halo_elems
