@@ -243,12 +243,21 @@ class ndarray:
         return self._view.read(self._base.read_expr())
 
     def write_expr(self, value: Expr):
+        # Only the written array's OWN flag gates the write (numpy: a view
+        # taken before the base was frozen stays writeable and writes
+        # through; ADVICE r1).  The recursion below must therefore bypass
+        # the ancestors' flags.
         if self._readonly:
             raise ValueError("assignment destination is read-only")
+        self._write_through(value)
+
+    def _write_through(self, value: Expr):
         if self._base is None:
             self._set_expr(value)
         else:
-            self._base.write_expr(self._view.write(self._base.read_expr(), value))
+            self._base._write_through(
+                self._view.write(self._base.read_expr(), value)
+            )
 
     @property
     def flags(self):
@@ -641,7 +650,12 @@ def _fix_reshape(size, shape):
 def expand_ellipsis(idx: tuple, ndim: int) -> tuple:
     """Replace an Ellipsis with the full slices it stands for (identity
     check: ``in`` would do elementwise == on array items)."""
-    if builtins.any(it is Ellipsis for it in idx):
+    n_ellipsis = sum(1 for it in idx if it is Ellipsis)
+    if n_ellipsis > 1:
+        raise IndexError(
+            "an index can only have a single ellipsis ('...')"
+        )
+    if n_ellipsis:
         pos = next(p for p, it in enumerate(idx) if it is Ellipsis)
         n_specified = sum(1 for i in idx if i is not None and i is not Ellipsis)
         fill = (slice(None),) * (ndim - n_specified)
@@ -757,26 +771,42 @@ def _install_operators():
         if not hasattr(ndarray, name):
             setattr(ndarray, name, meth)
 
-    for red in _REDUCTIONS:
-        # NumPy method positional order is (axis, dtype, out); everything
-        # else keyword-only so a stray positional can't land in keepdims.
+    def _finish_reduce(r, dtype, out, asarray):
+        if dtype is not None:
+            r = r.astype(dtype)
+        if asarray:
+            # Keep the (deferred) result in array form — shape (1,) for a
+            # full reduction — so the caller can hold it without forcing a
+            # flush (reference: reduction asarray kwarg, used e.g. at
+            # ramba.py:6778 and sample pi integration).
+            r = r.reshape((1,) if r.ndim == 0 else r.shape)
+        if out is not None:
+            out.write_expr(r.read_expr())
+            return out
+        return r
+
+    # NumPy method positional order differs per reduction: sum/prod/mean
+    # take (axis, dtype, out), min/max/any/all take (axis, out) — matching
+    # exactly so e.g. ``a.min(0, out_arr)`` writes out_arr instead of
+    # silently treating it as a dtype (ADVICE r1).  Everything past
+    # NumPy's positional tail is keyword-only.
+    for red in ("sum", "prod", "mean"):
         def rmeth(self, axis=None, dtype=None, out=None, *, keepdims=False,
                   asarray=False, _f=red):
-            r = self._reduce(_f, axis, keepdims)
-            if dtype is not None:
-                r = r.astype(dtype)
-            if asarray:
-                # Keep the (deferred) result in array form — shape (1,) for a
-                # full reduction — so the caller can hold it without forcing a
-                # flush (reference: reduction asarray kwarg, used e.g. at
-                # ramba.py:6778 and sample pi integration).
-                r = r.reshape((1,) if r.ndim == 0 else r.shape)
-            if out is not None:
-                out.write_expr(r.read_expr())
-                return out
-            return r
+            return _finish_reduce(
+                self._reduce(_f, axis, keepdims), dtype, out, asarray
+            )
 
         setattr(ndarray, red, rmeth)
+
+    for red in ("min", "max", "any", "all"):
+        def rmeth2(self, axis=None, out=None, *, keepdims=False,
+                   asarray=False, _f=red):
+            return _finish_reduce(
+                self._reduce(_f, axis, keepdims), None, out, asarray
+            )
+
+        setattr(ndarray, red, rmeth2)
 
 
 def _is_operand(x):

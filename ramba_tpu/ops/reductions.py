@@ -32,20 +32,27 @@ def _red(name, a, axis=None, keepdims=False, dtype=None, out=None, ddof=None,
     return r
 
 
-def sum(a, axis=None, keepdims=False, dtype=None, out=None, *,  # noqa: A001
+# Positional parameter order below follows NumPy exactly (np.sum(a, axis,
+# dtype, out, ...), np.min(a, axis, out, ...), np.var(a, axis, dtype, out,
+# ddof, ...)); everything past NumPy's positional tail is keyword-only so a
+# stray positional raises instead of silently landing in the wrong slot
+# (ADVICE r1: a.min(0, out) dropped out= without error).
+
+
+def sum(a, axis=None, dtype=None, out=None, *, keepdims=False,  # noqa: A001
         asarray=False):
     return _red("sum", a, axis, keepdims, dtype, out, asarray_form=asarray)
 
 
-def prod(a, axis=None, keepdims=False, dtype=None, out=None, *, asarray=False):
+def prod(a, axis=None, dtype=None, out=None, *, keepdims=False, asarray=False):
     return _red("prod", a, axis, keepdims, dtype, out, asarray_form=asarray)
 
 
-def min(a, axis=None, keepdims=False, out=None, *, asarray=False):  # noqa: A001
+def min(a, axis=None, out=None, *, keepdims=False, asarray=False):  # noqa: A001
     return _red("min", a, axis, keepdims, None, out, asarray_form=asarray)
 
 
-def max(a, axis=None, keepdims=False, out=None, *, asarray=False):  # noqa: A001
+def max(a, axis=None, out=None, *, keepdims=False, asarray=False):  # noqa: A001
     return _red("max", a, axis, keepdims, None, out, asarray_form=asarray)
 
 
@@ -53,71 +60,71 @@ amin = min
 amax = max
 
 
-def mean(a, axis=None, keepdims=False, dtype=None, out=None, *, asarray=False):
+def mean(a, axis=None, dtype=None, out=None, *, keepdims=False, asarray=False):
     return _red("mean", a, axis, keepdims, dtype, out, asarray_form=asarray)
 
 
-def var(a, axis=None, keepdims=False, ddof=0):
-    return _red("var", a, axis, keepdims, ddof=ddof)
+def var(a, axis=None, dtype=None, out=None, ddof=0, *, keepdims=False):
+    return _red("var", a, axis, keepdims, dtype, out, ddof=ddof)
 
 
-def std(a, axis=None, keepdims=False, ddof=0):
-    return _red("std", a, axis, keepdims, ddof=ddof)
+def std(a, axis=None, dtype=None, out=None, ddof=0, *, keepdims=False):
+    return _red("std", a, axis, keepdims, dtype, out, ddof=ddof)
 
 
-def any(a, axis=None, keepdims=False):  # noqa: A001
-    return _red("any", a, axis, keepdims)
+def any(a, axis=None, out=None, *, keepdims=False):  # noqa: A001
+    return _red("any", a, axis, keepdims, None, out)
 
 
-def all(a, axis=None, keepdims=False):  # noqa: A001
-    return _red("all", a, axis, keepdims)
+def all(a, axis=None, out=None, *, keepdims=False):  # noqa: A001
+    return _red("all", a, axis, keepdims, None, out)
 
 
-def median(a, axis=None, keepdims=False):
-    return _red("median", a, axis, keepdims)
+def median(a, axis=None, out=None, *, keepdims=False):
+    return _red("median", a, axis, keepdims, None, out)
 
 
-def ptp(a, axis=None, keepdims=False):
-    return _red("ptp", a, axis, keepdims)
+def ptp(a, axis=None, out=None, *, keepdims=False):
+    return _red("ptp", a, axis, keepdims, None, out)
 
 
-def argmin(a, axis=None):
-    return _red("argmin", a, axis)
+def argmin(a, axis=None, out=None, *, keepdims=False):
+    return _red("argmin", a, axis, keepdims, None, out)
 
 
-def argmax(a, axis=None):
-    return _red("argmax", a, axis)
+def argmax(a, axis=None, out=None, *, keepdims=False):
+    return _red("argmax", a, axis, keepdims, None, out)
 
 
-def nansum(a, axis=None, keepdims=False):
-    return _red("nansum", a, axis, keepdims)
+def nansum(a, axis=None, dtype=None, out=None, *, keepdims=False):
+    return _red("nansum", a, axis, keepdims, dtype, out)
 
 
-def nanprod(a, axis=None, keepdims=False):
-    return _red("nanprod", a, axis, keepdims)
+def nanprod(a, axis=None, dtype=None, out=None, *, keepdims=False):
+    return _red("nanprod", a, axis, keepdims, dtype, out)
 
 
-def nanmin(a, axis=None, keepdims=False):
-    return _red("nanmin", a, axis, keepdims)
+def nanmin(a, axis=None, out=None, *, keepdims=False):
+    return _red("nanmin", a, axis, keepdims, None, out)
 
 
-def nanmax(a, axis=None, keepdims=False):
-    return _red("nanmax", a, axis, keepdims)
+def nanmax(a, axis=None, out=None, *, keepdims=False):
+    return _red("nanmax", a, axis, keepdims, None, out)
 
 
-def nanmean(a, axis=None, keepdims=False):
-    return _red("nanmean", a, axis, keepdims)
+def nanmean(a, axis=None, dtype=None, out=None, *, keepdims=False):
+    return _red("nanmean", a, axis, keepdims, dtype, out)
 
 
-def nanvar(a, axis=None, keepdims=False, ddof=0):
-    return _red("nanvar", a, axis, keepdims, ddof=ddof)
+def nanvar(a, axis=None, dtype=None, out=None, ddof=0, *, keepdims=False):
+    return _red("nanvar", a, axis, keepdims, dtype, out, ddof=ddof)
 
 
-def nanstd(a, axis=None, keepdims=False, ddof=0):
-    return _red("nanstd", a, axis, keepdims, ddof=ddof)
+def nanstd(a, axis=None, dtype=None, out=None, ddof=0, *, keepdims=False):
+    return _red("nanstd", a, axis, keepdims, dtype, out, ddof=ddof)
 
 
-def count_nonzero(a, axis=None, keepdims=False):
+def count_nonzero(a, axis=None, *, keepdims=False):
     return _red("count_nonzero", a, axis, keepdims)
 
 

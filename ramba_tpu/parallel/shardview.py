@@ -42,12 +42,13 @@ def shard_slices(a) -> list:
 def divisions(a) -> np.ndarray:
     """Reference-style (n_shards, 2, ndim) start/end table
     (reference: divisions_to_distribution / distribution_to_divisions,
-    shardview_array.py:617-935)."""
+    shardview_array.py:617-935).  Covers EVERY shard via
+    devices_indices_map — addressable_shards alone would silently return a
+    partial table on a multi-host mesh (ADVICE r1)."""
     v = _concrete(a)
     nd = len(v.shape)
     out = []
-    for s in v.addressable_shards:
-        idx = s.index
+    for _dev, idx in _all_shard_indices(v):
         starts = [
             (sl.start if sl.start is not None else 0) for sl in idx
         ] + [0] * (nd - len(idx))
