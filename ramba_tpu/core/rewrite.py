@@ -324,6 +324,11 @@ RULES = [
     rewrite_concat_binop_getitem,
 ]
 
+# Per-rule fire counts (observability; lets end-to-end tests assert that an
+# xarray/pandas idiom actually took the rewritten path — cf. the reference's
+# DAG-rewrite debug prints, ramba.py:4567-4789).
+stats = {rule.__name__: 0 for rule in RULES}
+
 
 def rewrite_roots(roots):
     """Apply RULES bottom-up across the expression forest (iterative — chains
@@ -357,6 +362,7 @@ def rewrite_roots(roots):
                 except Exception:
                     r = None
                 if r is not None:
+                    stats[rule.__name__] += 1
                     cand = r
                     break
             memo[id(e)] = cand

@@ -18,7 +18,9 @@ from ramba_tpu.core.expr import Const, Node
 from ramba_tpu.core.ndarray import ndarray
 from ramba_tpu.parallel import mesh as _mesh
 
-_key = jax.random.key(0)
+# Created lazily: materializing a key at import would initialize the jax
+# backend before multi-controller users can call distributed.initialize().
+_key = None
 
 
 def seed(s: int) -> None:
@@ -29,6 +31,8 @@ def seed(s: int) -> None:
 
 def _next_key():
     global _key
+    if _key is None:
+        _key = jax.random.key(0)
     _key, sub = jax.random.split(_key)
     return sub
 

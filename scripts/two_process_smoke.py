@@ -1,0 +1,110 @@
+"""Two-process multi-controller smoke test (CPU backend).
+
+The reference CI runs its whole suite on a 2-worker cluster (mpiexec -n 2,
+/root/reference/.github/workflows/python-package.yml:40-46).  The TPU-native
+equivalent of that mode is jax multi-controller SPMD: every process runs the
+same program, `jax.distributed.initialize` forms the process group, and the
+global mesh spans both processes' devices (parallel/distributed.py).
+
+Run with no arguments to launch the 2-process test (exit 0 = pass):
+
+    python scripts/two_process_smoke.py
+
+Each worker: initializes the group, builds the cross-process global mesh,
+creates a sharded array, runs a cross-process all-reduce via rt.sum, an
+elementwise chain, and checks in_driver() gating.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def worker(rank: int, port: int) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+
+    sys.path.insert(0, REPO)
+    from ramba_tpu.parallel import distributed
+
+    distributed.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=2,
+        process_id=rank,
+    )
+    assert jax.process_count() == 2, jax.process_count()
+    assert distributed.process_index() == rank
+    assert len(jax.devices()) == 4, jax.devices()
+    assert len(distributed.local_devices()) == 2
+
+    import ramba_tpu as rt
+
+    mesh = distributed.global_mesh()
+    assert mesh.devices.size == 4
+    rt.set_mesh(mesh)
+
+    # sharded creation + fused chain + global reduction (the all-reduce
+    # crosses the process boundary)
+    n = 1 << 12
+    a = rt.arange(n, dtype=float)
+    d = rt.sin(a) * rt.sin(a) + rt.cos(a) ** 2
+    total = float(rt.sum(d))
+    assert abs(total - n) < 1e-6 * n, total
+
+    s = float(rt.sum(a))
+    assert s == n * (n - 1) / 2, s
+
+    # driver gating (reference: in_driver() in MPI SPMD mode)
+    if distributed.in_driver():
+        assert rank == 0
+        print("DRIVER_OK", flush=True)
+    else:
+        assert rank == 1
+    print(f"WORKER_{rank}_OK", flush=True)
+    distributed.shutdown()
+
+
+def launch() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO  # drop site hooks that force a TPU backend
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-u", os.path.abspath(__file__),
+             "WORKER", str(rank), str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for rank in range(2)
+    ]
+    ok = True
+    for rank, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out = f"rank {rank}: TIMEOUT"
+        if p.returncode != 0 or f"WORKER_{rank}_OK" not in (out or ""):
+            ok = False
+            print(f"--- rank {rank} rc={p.returncode} ---\n{out}",
+                  file=sys.stderr)
+    if ok:
+        print("two-process smoke: OK")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "WORKER":
+        worker(int(sys.argv[2]), int(sys.argv[3]))
+    else:
+        sys.exit(launch())

@@ -540,3 +540,26 @@ class TestAdviceBacklogR2:
             for i in range(d.shape[0])
         )
         assert total == 64 * 64
+
+class TestMultiProcess:
+    """The reference CI's mpiexec -n 2 leg (python-package.yml:40-46), as
+    jax multi-controller SPMD.  Spawns two fresh processes, so it is gated
+    behind RAMBA_TPU_MULTIPROC_TEST=1 to keep the default suite fast."""
+
+    @pytest.mark.skipif(
+        not os.environ.get("RAMBA_TPU_MULTIPROC_TEST"),
+        reason="set RAMBA_TPU_MULTIPROC_TEST=1 to run the 2-process smoke",
+    )
+    def test_two_process_smoke(self):
+        import subprocess
+        import sys
+
+        script = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts", "two_process_smoke.py",
+        )
+        r = subprocess.run(
+            [sys.executable, "-u", script], capture_output=True, text=True,
+            timeout=300,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
