@@ -45,18 +45,37 @@ def _spec_tuple_for(shape, distribution=None):
     return tuple(sh.spec)
 
 
+_local_border_noted = False
+
+
+def _note_local_border(k):
+    """``local_border`` is accepted for API parity with the reference's
+    preallocated per-shard halo storage (ramba.py:5409 ndarray(...,
+    local_border=)).  Here halo cells never live in the array: stencils
+    exchange exactly the probed neighborhood at run time (explicit ppermute
+    in ops/stencil_sharded.py, or GSPMD-inserted collectives), so a nonzero
+    value is a deliberate no-op — noted once at debug level 1."""
+    global _local_border_noted
+    if k and not _local_border_noted:
+        _local_border_noted = True
+        from ramba_tpu.common import dprint
+
+        dprint(1, "ramba_tpu: local_border is a no-op (halos are exchanged "
+                  "by the stencil engine, not stored in the array)")
+
+
 def empty(shape, dtype=float, local_border=0, distribution=None):
-    """`local_border` accepted for API parity with the reference's halo
-    padding (ramba.py:5409 ndarray(..., local_border=)); halos here are
-    carried by the stencil engine (parallel/stencil.py), not the array."""
+    _note_local_border(local_border)
     return full(shape, 0, dtype, distribution=distribution)
 
 
 def zeros(shape, dtype=float, local_border=0, distribution=None):
+    _note_local_border(local_border)
     return full(shape, 0, dtype, distribution=distribution)
 
 
 def ones(shape, dtype=float, local_border=0, distribution=None):
+    _note_local_border(local_border)
     return full(shape, 1, dtype, distribution=distribution)
 
 
