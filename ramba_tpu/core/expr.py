@@ -513,6 +513,12 @@ def _op_outer(static, a, b):
     return jnp.outer(a, b)
 
 
+@defop("trace")
+def _op_trace(static, a):
+    offset, axis1, axis2 = static
+    return jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2)
+
+
 # -- creation ----------------------------------------------------------------
 
 

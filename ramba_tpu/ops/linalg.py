@@ -82,11 +82,13 @@ def einsum(subscripts, *operands):
     )
 
 
-def trace(a, offset=0):
+def trace(a, offset=0, axis1=0, axis2=1):
+    """numpy.trace semantics for any rank >= 2 (sum along the matching
+    diagonal of the two selected axes; remaining axes stay)."""
     a = asarray(a)
-    n, m = a.shape[-2:]
-    from ramba_tpu.ops.manipulation import diag
-
-    if a.ndim == 2:
-        return diag(a, offset).sum()
-    raise NotImplementedError("trace only for 2-D arrays")
+    if a.ndim < 2:
+        raise ValueError("trace requires an array of at least 2 dimensions")
+    return ndarray(
+        Node("trace", (int(offset), int(axis1), int(axis2)),
+             [as_exprable(a)])
+    )

@@ -563,3 +563,17 @@ class TestMultiProcess:
             timeout=300,
         )
         assert r.returncode == 0, r.stdout + r.stderr
+
+class TestTraceND:
+    def test_trace_matches_numpy(self):
+        a = np.arange(24.0).reshape(2, 3, 4)
+        for kw in ({}, {"offset": 1}, {"axis1": 1, "axis2": 2},
+                   {"offset": -1, "axis1": 0, "axis2": 2}):
+            got = rt.trace(rt.fromarray(a), **kw).asarray()
+            np.testing.assert_allclose(got, np.trace(a, **{
+                "offset": kw.get("offset", 0),
+                "axis1": kw.get("axis1", 0),
+                "axis2": kw.get("axis2", 1),
+            }))
+        m = np.arange(16.0).reshape(4, 4)
+        assert float(rt.trace(rt.fromarray(m))) == np.trace(m)
