@@ -91,6 +91,45 @@ def _bench_stencil(rt, platform):
     return 13 * (sn - 4) * (sn - 4) / st_iter / 1e6
 
 
+def _bench_axpy(rt, n):
+    """BASELINE config 4: random-normal init + axpy.  Reports effective
+    GB/s over the dominant traffic (write x, write y, read both, write
+    out with donation ~= 4 n itemsize)."""
+    x = rt.random.normal(size=n)
+    y = rt.random.normal(size=n)
+    rt.sync()
+
+    def run():
+        t0 = time.perf_counter()
+        z = 2.5 * x + y
+        s = rt.sum(z)
+        float(s)
+        return time.perf_counter() - t0
+
+    run()
+    wall = min(run() for _ in range(2))
+    return 3 * n * 4 / 1e9 / wall  # read x,y + write z (f32)
+
+
+def _bench_broadcast(rt, n):
+    """BASELINE config 5: mixed-shard broadcast binop A[:,None]+B[None,:]
+    reduced to a scalar (the (n, n) outer result stays a fusion temp)."""
+    a = rt.random.uniform(size=n)
+    b = rt.random.uniform(size=n)
+    rt.sync()
+
+    def run():
+        t0 = time.perf_counter()
+        c = a[:, None] + b[None, :]
+        s = rt.sum(c)
+        float(s)
+        return time.perf_counter() - t0
+
+    run()
+    wall = min(run() for _ in range(2))
+    return n * n / 1e9 / wall  # Gelems of the broadcast grid per second
+
+
 def main():
     out = {
         "metric": "1e9-elem fused elementwise+reduce wall-clock",
@@ -160,6 +199,20 @@ def main():
             out["stencil_vs_ramba_1node"] = round(mflops / 49748, 2)
         except Exception:  # noqa: BLE001
             out["stencil_error"] = traceback.format_exc(limit=3)[-400:]
+
+        try:
+            out["axpy_gb_per_s"] = round(
+                _bench_axpy(rt, n if platform != "cpu" else 2_000_000), 1
+            )
+        except Exception:  # noqa: BLE001
+            out["axpy_error"] = traceback.format_exc(limit=2)[-300:]
+
+        try:
+            out["bcast_gelems_per_s"] = round(
+                _bench_broadcast(rt, 32768 if platform != "cpu" else 1024), 1
+            )
+        except Exception:  # noqa: BLE001
+            out["bcast_error"] = traceback.format_exc(limit=2)[-300:]
     except Exception:  # noqa: BLE001 - even import/backend failure emits JSON
         out["error"] = traceback.format_exc(limit=3)[-400:]
 

@@ -37,6 +37,11 @@ from jax.sharding import PartitionSpec as P
 from ramba_tpu import common
 from ramba_tpu.parallel import mesh as _mesh
 
+# Interior/halo overlap in the sharded path (off: single full-block eval)
+_OVERLAP = __import__("os").environ.get(
+    "RAMBA_TPU_STENCIL_OVERLAP", "1"
+) not in ("0", "")
+
 
 def _axis_entries(mesh, shape):
     """Mesh-axis assignment per array dim, mirroring the live default
@@ -138,7 +143,26 @@ def run(func, lo, hi, slots, arrs, taps):
         r0 = (jax.lax.axis_index(row_axes) if row_axes else 0) * lh
         c0 = (jax.lax.axis_index(col_axes) if col_axes else 0) * lw
 
-        val = _local_stencil(func, lo, hi, slots, exts, taps, (lh, lw))
+        from ramba_tpu.ops import stencil_pallas
+
+        ih, iw = lh - (top + bottom), lw - (left + right)
+        if (
+            _OVERLAP
+            and ih > 0
+            and iw > 0
+            and (top or bottom or left or right)
+            and not stencil_pallas.available_local(exts)
+        ):
+            # overlapped schedule: the interior strip depends only on the
+            # local block, so XLA runs it concurrently with the (async)
+            # halo collective-permutes; border strips wait on the halos.
+            # The reference gets the analogous overlap from Numba prange
+            # workers computing while ZMQ receives land (ramba.py:
+            # 3549-3780); here the latency-hiding scheduler does it.
+            val = _overlapped_val(func, lo, hi, slots, blocks, exts,
+                                  (lh, lw))
+        else:
+            val = _local_stencil(func, lo, hi, slots, exts, taps, (lh, lw))
         gr = jax.lax.broadcasted_iota(jnp.int32, (lh, lw), 0) + r0
         gc = jax.lax.broadcasted_iota(jnp.int32, (lh, lw), 1) + c0
         valid = (gr >= top) & (gr < H - bottom) & (gc >= left) & (gc < W - right)
@@ -154,6 +178,52 @@ def run(func, lo, hi, slots, arrs, taps):
     if (Hp, Wp) != (H, W):
         out = out[:H, :W]
     return out
+
+
+def _overlapped_val(func, lo, hi, slots, blocks, exts, shape):
+    """Local (lh, lw) stencil values assembled from five pieces:
+
+    * the interior — computed straight from the un-extended local blocks,
+      with NO data dependency on the halo ppermutes, and
+    * four border strips (top/bottom full-width, left/right between them)
+      — computed from the halo-extended blocks.
+
+    XLA's scheduler overlaps the halo transfer with the interior compute
+    because the dependence graph allows it.  Strips and interior tile the
+    block exactly (no cell computed twice)."""
+    from ramba_tpu.skeletons import stencil_interior
+
+    lh, lw = shape
+    top, left = -lo[0], -lo[1]
+    bottom, right = hi[0], hi[1]
+    hr, hc = top + bottom, left + right  # neighborhood extents
+
+    # interior: output rows [top, lh-bottom) x cols [left, lw-right)
+    interior = stencil_interior(func, lo, hi, slots, blocks)
+
+    def strip(r_lo, r_hi, c_lo, c_hi):
+        """Stencil values for output rows [r_lo, r_hi) x cols [c_lo, c_hi),
+        read from the ext blocks (output cell (r, c) needs ext rows
+        [r, r+hr] and cols [c, c+hc])."""
+        pieces = [
+            jax.lax.slice(e, (r_lo, c_lo), (r_hi + hr, c_hi + hc))
+            for e in exts
+        ]
+        return stencil_interior(func, lo, hi, slots, pieces)
+
+    rows = []
+    if top:
+        rows.append(strip(0, top, 0, lw))
+    mid = []
+    if left:
+        mid.append(strip(top, lh - bottom, 0, left))
+    mid.append(interior)
+    if right:
+        mid.append(strip(top, lh - bottom, lw - right, lw))
+    rows.append(mid[0] if len(mid) == 1 else jnp.concatenate(mid, axis=1))
+    if bottom:
+        rows.append(strip(lh - bottom, lh, 0, lw))
+    return rows[0] if len(rows) == 1 else jnp.concatenate(rows, axis=0)
 
 
 def _local_stencil(func, lo, hi, slots, exts, taps, interior):

@@ -143,6 +143,32 @@ class TestShardedStencil:
         for m in re.finditer(r"all-gather[^\n]*f32\[(\d+),(\d+)\]", hlo):
             assert (int(m.group(1)), int(m.group(2))) != (H, W), m.group(0)
 
+    def test_overlap_on_off_equivalent(self, monkeypatch):
+        """The overlapped schedule (interior from local data concurrent
+        with halo ppermutes, border strips after) must tile the block
+        exactly — same numerics as the single full-block evaluation."""
+        x = np.random.RandomState(8).rand(64, 48).astype(np.float32)
+        outs = {}
+        for flag in (True, False):
+            monkeypatch.setattr(stencil_sharded, "_OVERLAP", flag)
+            outs[flag] = rt.sstencil(_star2(), rt.fromarray(x)).asarray()
+        np.testing.assert_allclose(outs[True], outs[False], rtol=1e-6)
+        np.testing.assert_allclose(outs[True], _star2_numpy(x), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_overlap_used(self, monkeypatch):
+        calls = {"n": 0}
+        real = stencil_sharded._overlapped_val
+
+        def spy(*a, **k):
+            calls["n"] += 1
+            return real(*a, **k)
+
+        monkeypatch.setattr(stencil_sharded, "_overlapped_val", spy)
+        x = np.random.RandomState(9).rand(64, 64).astype(np.float32)
+        rt.sstencil(_star2(), rt.fromarray(x)).asarray()
+        assert calls["n"] >= 1
+
     def test_composed_with_pallas_interpret(self, monkeypatch):
         """shard_map + ppermute halos feeding the Pallas kernel (interpret
         mode on CPU; on TPU the same composition runs the Mosaic kernel)."""
