@@ -1,0 +1,76 @@
+"""2-D Jacobi/Poisson relaxation on the distributed stencil path.
+
+BASELINE config 3 (the reference benchmarks a 5-point Jacobi sweep with
+halo exchange; stencil machinery at /root/reference/ramba/ramba.py:
+3315-3376).  Each sweep is one ``sstencil`` — on a mesh that is the
+explicit ppermute halo exchange + local kernel of ops/stencil_sharded.py.
+
+``sstencil`` zeroes the one-cell border (cells without a full
+neighborhood), which doubles as the problem's zero Dirichlet boundary —
+interior updates read the boundary values before they are re-zeroed.
+"""
+
+from __future__ import annotations
+
+_KERNELS = {}
+
+
+def _kernels():
+    """Module-cached stencil kernels: the fuser's compile cache keys on
+    kernel identity, so stable function objects let every jacobi2d call
+    (not just every block within one call) reuse the compiled module."""
+    if not _KERNELS:
+        import ramba_tpu as rt
+
+        @rt.stencil
+        def sweep(u, rhs):
+            return 0.25 * (
+                u[-1, 0] + u[1, 0] + u[0, -1] + u[0, 1] + rhs[0, 0]
+            )
+
+        @rt.stencil
+        def lap(v):
+            return (
+                v[-1, 0] + v[1, 0] + v[0, -1] + v[0, 1] - 4.0 * v[0, 0]
+            )
+
+        _KERNELS["sweep"] = sweep
+        _KERNELS["lap"] = lap
+    return _KERNELS
+
+
+def jacobi2d(f, iters: int = 100, h: float = 1.0, flush_every: int = 25):
+    """Run ``iters`` Jacobi sweeps for  -lap(u) = f  with zero boundary.
+
+    ``f`` is the (n, n) right-hand side (array-like or framework array);
+    returns the framework array holding the iterate.
+
+    ``flush_every`` bounds the traced program to a fixed-size sweep block;
+    every block after the first has identical structure, so it reuses the
+    same compiled XLA module (the fuser's structure-keyed cache) — one
+    compile regardless of ``iters``.
+    """
+    import ramba_tpu as rt
+
+    f = rt.asarray(f)
+    sweep = _kernels()["sweep"]
+    u = rt.zeros(f.shape)
+    scaled = f * (h * h)
+    rt.sync()
+    for i in range(iters):
+        u = rt.sstencil(sweep, u, scaled)
+        if flush_every and (i + 1) % flush_every == 0:
+            rt.flush()
+    return u
+
+
+def residual(u, f, h: float = 1.0) -> float:
+    """Max-norm interior residual  | f + lap(u) |."""
+    import ramba_tpu as rt
+
+    u = rt.asarray(u)
+    f = rt.asarray(f)
+    r = rt.sstencil(_kernels()["lap"], u) / (h * h) + f
+    # exclude the boundary ring (sstencil already zeroes it for lap, but
+    # f is nonzero there)
+    return float(rt.max(rt.abs(r[1:-1, 1:-1])))

@@ -1,0 +1,49 @@
+"""Distributed k-means on framework primitives.
+
+A workload-level demonstration that the pieces compose the TPU-first way:
+pairwise distances ride the MXU (one matmul), assignment is an argmin,
+and the centroid update is the groupby segment reduction — the same
+machinery behind the xarray climatology pattern (groupby.py).  The
+reference exercises equivalent composite workloads through its sample
+notebooks (/root/reference/sample/).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def kmeans(points, k: int, iters: int = 10, seed: int = 0):
+    """Lloyd's algorithm.  ``points`` is (n, d) array-like.
+
+    Returns (centroids (k, d) numpy array, labels (n,) numpy array).
+    """
+    import ramba_tpu as rt
+
+    x = rt.asarray(points)
+    n, d = x.shape
+    rng = np.random.RandomState(seed)
+    centroids = rt.fromarray(
+        np.asarray(points)[rng.choice(n, size=k, replace=False)]
+    )
+
+    x_sq = (x * x).sum(1)  # (n,)
+    labels = None
+    for _ in range(iters):
+        # ||x - c||^2 = |x|^2 - 2 x.c + |c|^2 ; the cross term is the MXU
+        # matmul, the rest broadcasts
+        c_sq = (centroids * centroids).sum(1)  # (k,)
+        cross = x @ centroids.T  # (n, k)
+        dist = x_sq[:, None] - 2.0 * cross + c_sq[None, :]
+        labels = rt.argmin(dist, axis=1)
+
+        # centroid update: per-cluster mean via the segment reduction
+        lab_host = np.asarray(labels)
+        gb = x.groupby(0, lab_host, num_groups=k)
+        sums = gb.sum()  # (k, d)
+        counts = np.maximum(
+            np.bincount(lab_host, minlength=k), 1
+        ).astype(float)
+        centroids = sums / rt.fromarray(counts)[:, None]
+
+    return np.asarray(centroids), np.asarray(labels)

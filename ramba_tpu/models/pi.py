@@ -1,0 +1,20 @@
+"""Monte-Carlo-free pi integration — the reference's fused-chain showcase.
+
+Reference: the CI memory-behavior invariant integrates 4/(1+x^2) over 2e9
+points and asserts the whole chain fuses (no temporaries materialize,
+/root/reference/ramba/tests/test_distributed_array.py:100-108).
+
+Here the same chain builds one lazy expression; the flush emits a single
+XLA module whose only materialized value is the scalar sum.
+"""
+
+from __future__ import annotations
+
+
+def integrate_pi(n: int = 10_000_000) -> float:
+    """Midpoint-rule integral of 4/(1+x^2) on [0, 1] with n points."""
+    import ramba_tpu as rt
+
+    h = 1.0 / n
+    x = (rt.arange(n) + 0.5) * h
+    return float(rt.sum(4.0 / (1.0 + x * x)) * h)

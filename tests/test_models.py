@@ -1,0 +1,72 @@
+"""Workload-level models built on the framework (ramba_tpu/models/)."""
+
+import numpy as np
+
+import ramba_tpu as rt
+from ramba_tpu.core import fuser
+from ramba_tpu.models.jacobi import jacobi2d, residual
+from ramba_tpu.models.kmeans import kmeans
+from ramba_tpu.models.pi import integrate_pi
+
+
+class TestPi:
+    def test_value(self):
+        assert abs(integrate_pi(1_000_000) - np.pi) < 1e-9
+
+    def test_fully_fused(self):
+        rt.sync()
+        before = fuser.stats["flushes"]
+        integrate_pi(100_000)
+        assert fuser.stats["flushes"] == before + 1
+
+
+class TestJacobi:
+    def test_converges_toward_solution(self):
+        n = 16
+        f = np.ones((n, n))
+        u = jacobi2d(f, iters=400)
+        # after many sweeps the interior residual is far below the rhs
+        assert residual(u, f) < 0.05
+        # symmetric problem -> symmetric iterate
+        ua = u.asarray()
+        np.testing.assert_allclose(ua, ua.T, atol=1e-6)
+
+    def test_block_flushing_reuses_compiles(self):
+        from ramba_tpu.core import fuser
+
+        f = np.ones((16, 16))
+        jacobi2d(f, iters=100, flush_every=25)  # warm the cache
+        before = fuser.stats["compiles"]
+        jacobi2d(f, iters=100, flush_every=25)
+        # identical block structure -> no new XLA modules
+        assert fuser.stats["compiles"] == before
+
+    def test_matches_numpy_sweeps(self):
+        n = 24
+        rng = np.random.RandomState(0)
+        f = rng.rand(n, n)
+        got = jacobi2d(f, iters=5).asarray()
+        u = np.zeros((n, n))
+        for _ in range(5):
+            nxt = np.zeros_like(u)
+            nxt[1:-1, 1:-1] = 0.25 * (
+                u[:-2, 1:-1] + u[2:, 1:-1] + u[1:-1, :-2] + u[1:-1, 2:]
+                + f[1:-1, 1:-1]
+            )
+            u = nxt
+        np.testing.assert_allclose(got, u, rtol=1e-6, atol=1e-8)
+
+
+class TestKMeans:
+    def test_separated_clusters(self):
+        rng = np.random.RandomState(1)
+        a = rng.randn(60, 2) + np.array([10.0, 0.0])
+        b = rng.randn(60, 2) + np.array([-10.0, 0.0])
+        pts = np.concatenate([a, b])
+        cents, labels = kmeans(pts, k=2, iters=8)
+        # the two clusters are recovered: labels constant within each half
+        assert len(set(labels[:60])) == 1
+        assert len(set(labels[60:])) == 1
+        assert labels[0] != labels[60]
+        got = np.sort(cents[:, 0])
+        np.testing.assert_allclose(got, [-10.0, 10.0], atol=0.5)
