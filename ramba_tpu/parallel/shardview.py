@@ -86,6 +86,94 @@ def find_owning_worker(a, index) -> int:
     raise IndexError(f"index {index} out of bounds for shape {v.shape}")
 
 
+# ---------------------------------------------------------------------------
+# Division-table algebra
+#
+# The reference's shardview algebra (mapslice/intersect/broadcast/
+# make_uni_dist, shardview_array.py:414-1017) operates on packed int32
+# shardviews because every view/assignment must be routed by hand over
+# ZMQ/MPI.  Under XLA the layout lives in NamedSharding and GSPMD routes
+# data, so what remains useful is the same *queries* as plain box algebra
+# over (n_shards, 2, ndim) start/end tables — for spmd kernels, I/O
+# planning, and owner lookups.
+# ---------------------------------------------------------------------------
+
+
+def slice_divisions(divs: np.ndarray, index) -> np.ndarray:
+    """Division table of ``a[index]`` in the sliced coordinate system
+    (reference: mapslice + slice_distribution, shardview_array.py:414-614,
+    617-695).  ``index`` is a tuple of slices (ints/None allowed); steps
+    must be positive.  Empty per-shard boxes come out start == end."""
+    divs = np.asarray(divs)
+    nd = divs.shape[2]
+    if not isinstance(index, tuple):
+        index = (index,)
+    index = index + (slice(None),) * (nd - len(index))
+    out = divs.copy()
+    dims = divs[:, 1, :].max(axis=0) if len(divs) else np.zeros(nd, int)
+    for d, sl in enumerate(index):
+        if isinstance(sl, int):
+            sl = slice(sl, sl + 1)
+        start, stop, step = sl.indices(int(dims[d]))
+        if step != 1:
+            raise NotImplementedError("slice_divisions: positive unit steps")
+        lo = np.clip(divs[:, 0, d], start, stop) - start
+        hi = np.clip(divs[:, 1, d], start, stop) - start
+        out[:, 0, d] = lo
+        out[:, 1, d] = np.maximum(lo, hi)
+    return out
+
+
+def intersect_divisions(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Per-shard box intersection of two aligned tables (reference:
+    intersect, shardview_array.py:486-530)."""
+    a, b = np.asarray(a), np.asarray(b)
+    lo = np.maximum(a[:, 0, :], b[:, 0, :])
+    hi = np.minimum(a[:, 1, :], b[:, 1, :])
+    return np.stack([lo, np.maximum(lo, hi)], axis=1)
+
+
+def broadcast_divisions(divs: np.ndarray, shape) -> np.ndarray:
+    """Expand a table to a broadcast ``shape`` (reference: broadcast,
+    shardview_array.py:978-1017): new leading dims and size-1 dims cover
+    the full broadcast extent on every shard."""
+    divs = np.asarray(divs)
+    n, _, nd = divs.shape
+    shape = tuple(int(s) for s in shape)
+    grow = len(shape) - nd
+    if grow < 0:
+        raise ValueError("broadcast shape has fewer dims than the table")
+    out = np.zeros((n, 2, len(shape)), divs.dtype)
+    out[:, 1, :grow] = np.asarray(shape[:grow])
+    for d in range(nd):
+        D = grow + d
+        if np.all(divs[:, 1, d] <= 1) and shape[D] > 1:
+            # size-1 source dim broadcast up: every shard sees the full
+            # extent (the value is replicated along it)
+            out[:, 0, D] = 0
+            out[:, 1, D] = shape[D]
+        else:
+            out[:, 0, D] = divs[:, 0, d]
+            out[:, 1, D] = divs[:, 1, d]
+    return out
+
+
+def make_uni_divisions(shape, worker: int = 0, n_workers=None) -> np.ndarray:
+    """Whole array on one worker, empty boxes elsewhere (reference:
+    make_uni_dist, shardview_array.py:1142-1158)."""
+    shape = tuple(int(s) for s in shape)
+    n = int(n_workers if n_workers is not None else _mesh.num_workers())
+    out = np.zeros((n, 2, len(shape)), np.int64)
+    out[worker, 1, :] = shape
+    return out
+
+
+def divisions_size(divs: np.ndarray) -> np.ndarray:
+    """Element count per shard box."""
+    divs = np.asarray(divs)
+    return np.prod(np.maximum(0, divs[:, 1, :] - divs[:, 0, :]), axis=1)
+
+
 def default_distribution(shape) -> np.ndarray:
     """Division table the default partitioner would choose for ``shape``
     (reference: default_distribution, shardview_array.py:907-935).  Pure

@@ -118,3 +118,71 @@ class TestTwoDMeshRegressions:
         # elements.  A 1-D 8-way split would move full 256-wide rows
         # (>=512 elements per shard pair).  Assert the 2-D regime.
         assert 0 < halo_elems < 512, halo_elems
+
+
+class TestDivisionAlgebra:
+    """Box algebra over (n_shards, 2, ndim) division tables — the query
+    surface of the reference's shardview algebra (shardview_array.py:
+    414-1017), reduced to what matters without hand-routed comm."""
+
+    def _table(self):
+        from ramba_tpu.parallel.shardview import divisions
+
+        a = rt.zeros((64, 64))
+        rt.sync()
+        return divisions(a)
+
+    def test_slice_divisions_covers_slice(self):
+        from ramba_tpu.parallel.shardview import divisions_size, slice_divisions
+
+        d = self._table()
+        s = slice_divisions(d, (slice(10, 50), slice(None, 32)))
+        # boxes tile the sliced region exactly
+        assert int(divisions_size(s).sum()) == 40 * 32
+        assert s[:, 1, 0].max() == 40 and s[:, 1, 1].max() == 32
+
+    def test_slice_divisions_int_index(self):
+        from ramba_tpu.parallel.shardview import divisions_size, slice_divisions
+
+        d = self._table()
+        s = slice_divisions(d, (7,))
+        assert int(divisions_size(s).sum()) == 64  # one row, all cols
+
+    def test_intersect(self):
+        from ramba_tpu.parallel.shardview import (
+            divisions_size, intersect_divisions,
+        )
+
+        d = self._table()
+        full = intersect_divisions(d, d)
+        np.testing.assert_array_equal(full, d)
+        # intersect with a disjoint table is empty
+        import numpy as _np
+
+        shifted = d.copy()
+        shifted[:, :, 0] += 64
+        assert int(divisions_size(intersect_divisions(d, shifted)).sum()) == 0
+
+    def test_broadcast(self):
+        from ramba_tpu.parallel.shardview import (
+            broadcast_divisions, divisions_size,
+        )
+
+        n = 8
+        one = np.zeros((n, 2, 2), np.int64)
+        one[:, 1, 0] = np.arange(n) + 1  # uneven row boxes
+        one[:, 0, 0] = np.arange(n)
+        one[:, 1, 1] = 1  # size-1 col dim
+        b = broadcast_divisions(one, (3, n, 5))
+        assert b.shape == (n, 2, 3)
+        # new leading dim + broadcast col dim cover the full extent
+        assert (b[:, 1, 0] == 3).all() and (b[:, 1, 2] == 5).all()
+
+    def test_make_uni(self):
+        from ramba_tpu.parallel.shardview import (
+            divisions_size, make_uni_divisions,
+        )
+
+        u = make_uni_divisions((4, 4), worker=2, n_workers=8)
+        sizes = divisions_size(u)
+        assert sizes[2] == 16 and sizes.sum() == 16
