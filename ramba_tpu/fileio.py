@@ -197,8 +197,19 @@ def _shard_chunks(arr):
     one shard per step.  Falls back to one whole-array chunk for plain
     hosts arrays."""
     if isinstance(arr, ndarray):
+        import jax
+
         from ramba_tpu.core.fuser import flush
 
+        if jax.process_count() > 1:
+            # multi-controller: each process sees only its own shards, and
+            # every process would truncate the same file — refuse loudly
+            # rather than write a silently partial one
+            raise NotImplementedError(
+                "save() under multi-controller execution is not supported "
+                "yet: gather to the driver (np.asarray of a replicated "
+                "array) or write per-process files"
+            )
         flush()
         v = arr._value()
         seen = set()

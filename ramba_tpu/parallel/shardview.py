@@ -102,19 +102,36 @@ def find_owning_worker(a, index) -> int:
 def slice_divisions(divs: np.ndarray, index) -> np.ndarray:
     """Division table of ``a[index]`` in the sliced coordinate system
     (reference: mapslice + slice_distribution, shardview_array.py:414-614,
-    617-695).  ``index`` is a tuple of slices (ints/None allowed); steps
-    must be positive.  Empty per-shard boxes come out start == end."""
+    617-695).  ``index`` is a tuple of slices and/or ints (negative
+    allowed, NumPy semantics); steps must be positive unit.  Empty
+    per-shard boxes come out start == end."""
     divs = np.asarray(divs)
     nd = divs.shape[2]
     if not isinstance(index, tuple):
         index = (index,)
     index = index + (slice(None),) * (nd - len(index))
+    if len(index) != nd:
+        raise IndexError(
+            f"too many indices for a {nd}-dim division table: {index!r}"
+        )
     out = divs.copy()
     dims = divs[:, 1, :].max(axis=0) if len(divs) else np.zeros(nd, int)
     for d, sl in enumerate(index):
-        if isinstance(sl, int):
-            sl = slice(sl, sl + 1)
-        start, stop, step = sl.indices(int(dims[d]))
+        dim = int(dims[d])
+        if isinstance(sl, (int, np.integer)):
+            i = int(sl)
+            if i < 0:
+                i += dim
+            if not 0 <= i < dim:
+                raise IndexError(
+                    f"index {sl} out of bounds for dim {d} of size {dim}"
+                )
+            sl = slice(i, i + 1)
+        elif not isinstance(sl, slice):
+            raise TypeError(
+                f"slice_divisions supports slices and ints, got {sl!r}"
+            )
+        start, stop, step = sl.indices(dim)
         if step != 1:
             raise NotImplementedError("slice_divisions: positive unit steps")
         lo = np.clip(divs[:, 0, d], start, stop) - start

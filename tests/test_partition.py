@@ -147,6 +147,16 @@ class TestDivisionAlgebra:
         d = self._table()
         s = slice_divisions(d, (7,))
         assert int(divisions_size(s).sum()) == 64  # one row, all cols
+        # negative index: numpy semantics (last row)
+        s2 = slice_divisions(d, (-1,))
+        assert int(divisions_size(s2).sum()) == 64
+        np.testing.assert_array_equal(
+            divisions_size(s2), divisions_size(slice_divisions(d, (63,)))
+        )
+        with pytest.raises(IndexError):
+            slice_divisions(d, (64,))
+        with pytest.raises(TypeError):
+            slice_divisions(d, (None,))
 
     def test_intersect(self):
         from ramba_tpu.parallel.shardview import (

@@ -147,10 +147,16 @@ class TestShardedStencil:
         """The overlapped schedule (interior from local data concurrent
         with halo ppermutes, border strips after) must tile the block
         exactly — same numerics as the single full-block evaluation."""
+        from ramba_tpu.core import fuser
+
         x = np.random.RandomState(8).rand(64, 48).astype(np.float32)
         outs = {}
         for flag in (True, False):
             monkeypatch.setattr(stencil_sharded, "_OVERLAP", flag)
+            # fresh kernel objects per iteration already force a retrace
+            # (the kernel function is part of the program key); clear the
+            # cache anyway so the flag is provably consulted
+            fuser._compile_cache.clear()
             outs[flag] = rt.sstencil(_star2(), rt.fromarray(x)).asarray()
         np.testing.assert_allclose(outs[True], outs[False], rtol=1e-6)
         np.testing.assert_allclose(outs[True], _star2_numpy(x), rtol=1e-5,
