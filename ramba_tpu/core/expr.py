@@ -188,9 +188,58 @@ MAPFN["where"] = jnp.where
 MAPFN["matmul_elem"] = jnp.multiply  # placeholder slot
 
 
+def _np_loop_dtypes(fname, args):
+    """NumPy's exact (input..., output) dtypes for this ufunc application
+    under NEP 50 — weak-typed jax values stand in as python scalars.
+    Returns None when numpy promotion should not be enforced: x64 disabled
+    (32-bit TPU execution keeps jax's own lattice — widening everything to
+    f64 there would be both slow and silently truncated anyway), fname not
+    a numpy ufunc, or unresolvable."""
+    import jax as _jax
+
+    if not _jax.config.jax_enable_x64:
+        return None
+    uf = getattr(np, fname, None)
+    if not isinstance(uf, np.ufunc) or uf.nin != len(args) or uf.nout != 1:
+        return None
+    ins = []
+    for a in args:
+        dt = getattr(a, "dtype", None)
+        if dt is None:
+            if isinstance(a, (bool, int, float, complex)):
+                ins.append(type(a))
+                continue
+            return None
+        if getattr(a, "weak_type", False):
+            kind = np.dtype(dt).kind
+            ins.append({"b": bool, "i": int, "u": int, "f": float,
+                        "c": complex}.get(kind, np.dtype(dt)))
+        else:
+            ins.append(np.dtype(dt))
+    try:
+        return uf.resolve_dtypes(tuple(ins) + (None,))
+    except Exception:
+        return None
+
+
 @defop("map")
 def _op_map(static, *args):
     (fname,) = static
+    loop = _np_loop_dtypes(fname, args)
+    if loop is not None:
+        # cast INPUTS to numpy's loop dtypes (computing in the wider type,
+        # not just relabeling the result) — the reference computes with
+        # numpy/Numba and so gets these semantics for free
+        args = tuple(
+            a if getattr(a, "dtype", None) == d
+            and not getattr(a, "weak_type", True)
+            else jnp.asarray(a, d)
+            for a, d in zip(args, loop[:-1])
+        )
+        out = MAPFN[fname](*args)
+        if out.dtype != loop[-1]:
+            out = out.astype(loop[-1])
+        return out
     return MAPFN[fname](*args)
 
 

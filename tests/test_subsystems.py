@@ -577,3 +577,42 @@ class TestTraceND:
             }))
         m = np.arange(16.0).reshape(4, 4)
         assert float(rt.trace(rt.fromarray(m))) == np.trace(m)
+
+class TestDtypePromotionParity:
+    """NumPy NEP-50 promotion parity (the reference computes with
+    numpy/Numba and inherits these semantics; here numpy's own
+    ufunc.resolve_dtypes supplies the loop dtypes under x64)."""
+
+    DTYPES = [np.int8, np.uint8, np.int32, np.int64, np.float32,
+              np.float64, np.bool_]
+
+    def test_binop_matrix(self):
+        import warnings
+
+        for d1 in self.DTYPES:
+            for d2 in self.DTYPES:
+                a = np.ones(4, dtype=d1)
+                b = np.full(4, 2, dtype=d2)
+                for op in ("add", "multiply", "true_divide", "maximum"):
+                    with warnings.catch_warnings():
+                        warnings.simplefilter("ignore")
+                        want = getattr(np, op)(a, b)
+                        got = getattr(np, op)(
+                            rt.fromarray(a), rt.fromarray(b)
+                        ).asarray()
+                    assert got.dtype == want.dtype, (op, d1, d2, got.dtype)
+                    np.testing.assert_allclose(got, want)
+
+    def test_weak_scalar_promotion(self):
+        # NEP 50: int32_arr + python_float -> float64; f32_arr + float -> f32
+        x = rt.fromarray(np.ones(4, np.int32))
+        assert (x + 2.0).asarray().dtype == np.float64
+        y = rt.fromarray(np.ones(4, np.float32))
+        assert (y + 2.0).asarray().dtype == np.float32
+        assert (x + 2).asarray().dtype == np.int32
+
+    def test_int_division_is_float64(self):
+        a = rt.fromarray(np.array([1, 2, 7], np.int32))
+        r = (a / rt.fromarray(np.array([2, 4, 2], np.int32))).asarray()
+        assert r.dtype == np.float64
+        np.testing.assert_allclose(r, [0.5, 0.5, 3.5])
