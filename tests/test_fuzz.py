@@ -1,0 +1,131 @@
+"""Seeded differential fuzz: random op pipelines vs NumPy.
+
+The reference's suite is differential (`run_both` closures executed under
+numpy and under the framework); this generalizes it: deterministic random
+programs chain creation, elementwise ops, views, reductions, and
+manipulation over both backends and must agree in dtype and numerically
+in value (f32 reductions allow accumulation-order noise — XLA reduces in
+tree order, numpy sequentially).  Seeds are fixed so failures reproduce.
+"""
+
+import numpy as np
+import pytest
+
+import ramba_tpu as rt
+
+UNARY = ["negative", "abs", "sqrt", "exp", "log1p", "floor", "tanh", "square"]
+BINARY = ["add", "subtract", "multiply", "maximum", "minimum", "arctan2",
+          "hypot", "true_divide"]
+REDUCE = ["sum", "mean", "min", "max", "std", "prod"]
+
+
+def _rand_array(rng, max_nd=2):
+    nd = rng.randint(1, max_nd + 1)
+    shape = tuple(int(rng.randint(2, 9)) for _ in range(nd))
+    kind = rng.randint(3)
+    if kind == 0:
+        return rng.rand(*shape)  # f64 in [0,1): safe for log1p/sqrt
+    if kind == 1:
+        return rng.rand(*shape).astype(np.float32)
+    return rng.randint(1, 9, size=shape).astype(np.int64)
+
+
+def _rand_view(rng, shape):
+    """A random basic-index view keeping every dim nonempty."""
+    idx = []
+    for dim in shape:
+        c = rng.randint(3)
+        if c == 0:
+            idx.append(slice(None))
+        elif c == 1:
+            lo = rng.randint(0, dim)
+            idx.append(slice(lo, rng.randint(lo + 1, dim + 1)))
+        else:
+            idx.append(slice(None, None, -1))
+    return tuple(idx)
+
+
+def _gen_program(seed):
+    """Emit (arrays, ops) where every op is valid by construction — shapes
+    are simulated exactly during generation."""
+    rng = np.random.RandomState(seed)
+    arrays = [_rand_array(rng) for _ in range(3)]
+    shapes = [a.shape for a in arrays]
+    ops = []
+    for _ in range(rng.randint(4, 10)):
+        c = rng.randint(5)
+        i = rng.randint(len(shapes))
+        if c == 0:
+            ops.append(("unary", (UNARY[rng.randint(len(UNARY))], i)))
+            shapes.append(shapes[i])
+        elif c == 1:
+            j = rng.randint(len(shapes))
+            if shapes[i] != shapes[j] or shapes[i] == ():
+                continue
+            ops.append(("binary", (BINARY[rng.randint(len(BINARY))], i, j)))
+            shapes.append(shapes[i])
+        elif c == 2:
+            if not shapes[i]:
+                continue
+            idx = _rand_view(rng, shapes[i])
+            ops.append(("view", (i, idx)))
+            shapes.append(tuple(
+                len(range(*sl.indices(d)))
+                for sl, d in zip(idx, shapes[i])
+            ))
+        elif c == 3:
+            ops.append(("transpose", i))
+            shapes.append(tuple(reversed(shapes[i])))
+        else:
+            axis = 0 if (shapes[i] and rng.randint(2)) else None
+            ops.append(("reduce", (REDUCE[rng.randint(len(REDUCE))], i, axis)))
+            shapes.append(() if axis is None else shapes[i][1:])
+    return arrays, ops
+
+
+def _run_program(app, arrays, ops):
+    vals = [app.asarray(a) for a in arrays]
+    for kind, payload in ops:
+        if kind == "unary":
+            name, i = payload
+            vals.append(getattr(app, name)(vals[i]))
+        elif kind == "binary":
+            name, i, j = payload
+            vals.append(getattr(app, name)(vals[i], vals[j]))
+        elif kind == "view":
+            i, idx = payload
+            vals.append(vals[i][idx])
+        elif kind == "transpose":
+            vals.append(vals[payload].T)
+        else:
+            name, i, axis = payload
+            vals.append(getattr(app, name)(vals[i], axis=axis))
+    return [np.asarray(v) for v in vals]
+
+
+def _check(seed):
+    arrays, ops = _gen_program(seed)
+    want = _run_program(np, arrays, ops)
+    got = _run_program(rt, arrays, ops)
+    assert len(want) == len(got)
+    for k, (w, g) in enumerate(zip(want, got)):
+        assert g.shape == w.shape, (seed, k, g.shape, w.shape)
+        assert g.dtype == w.dtype, (seed, k, g.dtype, w.dtype)
+        rtol = 3e-5 if w.dtype == np.float32 else 1e-6
+        np.testing.assert_allclose(g, w, rtol=rtol, atol=1e-12,
+                                   err_msg=f"value {k} (seed {seed})")
+
+
+@pytest.mark.parametrize("seed", range(40))
+def test_random_program(seed):
+    _check(seed)
+
+
+@pytest.mark.skipif(
+    not __import__("os").environ.get("RAMBA_TPU_FUZZ_WIDE"),
+    reason="set RAMBA_TPU_FUZZ_WIDE=1 for the 500-seed sweep",
+)
+@pytest.mark.parametrize("block", range(10))
+def test_random_program_wide(block):
+    for seed in range(40 + block * 46, 40 + (block + 1) * 46):
+        _check(seed)
