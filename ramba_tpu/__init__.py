@@ -71,6 +71,7 @@ from ramba_tpu.skeletons import (  # noqa: F401
 )
 from ramba_tpu.groupby import RambaGroupby  # noqa: F401
 from ramba_tpu.fileio import Dataset, load, register_loader, save  # noqa: F401
+from ramba_tpu import checkpoint  # noqa: F401
 from ramba_tpu import random  # noqa: F401
 from ramba_tpu.parallel import distributed  # noqa: F401
 from ramba_tpu.parallel.constraints import (  # noqa: F401

@@ -1,0 +1,69 @@
+"""Checkpoint/restore of (trees of) distributed arrays via Orbax.
+
+The reference has no checkpointing at all (SURVEY §5 — fileio.save is
+already an extension); this module goes further the TPU-native way:
+Orbax writes each array's shards from their owning devices (OCDBT format)
+and restores them directly into a target sharding, so neither direction
+stages the full array on the host.
+
+API:
+
+    ramba_tpu.checkpoint.save(path, {"w": W, "b": B})
+    state = ramba_tpu.checkpoint.restore(path)            # saved shardings
+    state = ramba_tpu.checkpoint.restore(path, target)    # re-shard to target
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+from ramba_tpu.core.expr import Const
+from ramba_tpu.core.fuser import flush
+from ramba_tpu.core.ndarray import ndarray
+
+
+def save(path: str, tree, *, force: bool = True) -> None:
+    """Write a pytree of framework arrays (device-direct, sharded)."""
+    import orbax.checkpoint as ocp
+
+    flush()
+    vals = jax.tree.map(
+        lambda x: x._value() if isinstance(x, ndarray) else np.asarray(x),
+        tree,
+    )
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(path), vals, force=force)
+
+
+def restore(path: str, target=None):
+    """Read a checkpoint back as a pytree of framework arrays.
+
+    Without ``target``, arrays come back with the shardings they were
+    saved with.  With ``target`` (a pytree of framework arrays or
+    ``jax.ShapeDtypeStruct`` with shardings), each leaf restores straight
+    into that spec — how a resumed run re-shards a checkpoint onto a
+    different mesh."""
+    import orbax.checkpoint as ocp
+
+    def spec(x):
+        if isinstance(x, ndarray):
+            v = x._value()
+            return jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=v.sharding)
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return x
+        raise TypeError(
+            f"restore target leaves must be framework arrays or "
+            f"ShapeDtypeStructs, got {type(x).__name__}"
+        )
+
+    with ocp.StandardCheckpointer() as ckptr:
+        if target is not None:
+            out = ckptr.restore(
+                os.path.abspath(path), jax.tree.map(spec, target)
+            )
+        else:
+            out = ckptr.restore(os.path.abspath(path))
+    return jax.tree.map(lambda v: ndarray(Const(v)), out)
