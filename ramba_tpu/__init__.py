@@ -81,7 +81,7 @@ from ramba_tpu.utils.remote import get, jit, remote  # noqa: F401
 from ramba_tpu.utils import debug  # noqa: F401
 from ramba_tpu.utils import timing  # noqa: F401
 from ramba_tpu.utils.timing import (  # noqa: F401
-    get_timing, print_comm_stats, timing_summary,
+    annotate, get_timing, print_comm_stats, profiler_trace, timing_summary,
 )
 from ramba_tpu.utils.timing import reset as reset_timing  # noqa: F401
 

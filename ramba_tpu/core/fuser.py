@@ -287,7 +287,15 @@ def flush(extra: Sequence[Expr] = ()) -> list:
     t0 = time.perf_counter()
     with warnings.catch_warnings():
         warnings.filterwarnings("ignore", message=".*[Dd]onat.*")
-        outs = fn(*leaf_vals)
+        if common.timing_level > 1:
+            # label the dispatch in profiler traces (utils.timing.
+            # profiler_trace); off the hot path unless RAMBA_TIMING>=2
+            import jax.profiler as _prof
+
+            with _prof.TraceAnnotation(_program_label(program)):
+                outs = fn(*leaf_vals)
+        else:
+            outs = fn(*leaf_vals)
     dt = time.perf_counter() - t0
     if is_new:
         # jax.jit compiles lazily: the first call pays trace+lower+XLA

@@ -58,7 +58,7 @@ def _axis_entries(mesh, shape):
 
 
 def eligible(lo, hi, arrs) -> bool:
-    """True when the explicit ppermute halo path applies."""
+    """True when the explicit ppermute halo path applies (any rank)."""
     mesh = _mesh.get_mesh()
     n = mesh.devices.size
     if n <= 1:
@@ -67,22 +67,20 @@ def eligible(lo, hi, arrs) -> bool:
     if len(shapes) != 1:
         return False
     (shape,) = shapes
-    if len(shape) != 2:
+    if len(shape) < 1 or len(shape) != len(lo):
         return False
     if math.prod(shape) < common.dist_threshold:
         return False  # replicated small arrays: local compute is free
     ents = _axis_entries(mesh, shape)
     if not any(ents):
         return False  # layout says replicate — nothing to exchange
-    nr = math.prod(mesh.shape[a] for a in ents[0]) if ents[0] else 1
-    nc = math.prod(mesh.shape[a] for a in ents[1]) if ents[1] else 1
-    H, W = shape
-    top, left = -lo[0], -lo[1]
-    bottom, right = hi[0], hi[1]
-    # each halo must fit inside one neighbor shard
-    lh = -(-H // nr)
-    lw = -(-W // nc)
-    return max(top, bottom) <= lh and max(left, right) <= lw
+    for d in range(len(shape)):
+        nd = math.prod(mesh.shape[a] for a in ents[d]) if ents[d] else 1
+        ld = -(-shape[d] // nd)
+        # each halo must fit inside one neighbor shard
+        if max(-lo[d], hi[d]) > ld:
+            return False
+    return True
 
 
 def _exchange(x, axis, axes_names, nshards, lo_amt, hi_amt):
@@ -113,44 +111,48 @@ def _exchange(x, axis, axes_names, nshards, lo_amt, hi_amt):
 
 
 def run(func, lo, hi, slots, arrs, taps):
-    """Evaluate the stencil over the mesh with explicit halo exchange.
-    Returns the full-shape result with border cells zeroed."""
+    """Evaluate the stencil over the mesh with explicit halo exchange
+    (any rank).  Returns the full-shape result with border cells zeroed."""
     mesh = _mesh.get_mesh()
     x = arrs[0]
-    H, W = x.shape
-    top, left = -lo[0], -lo[1]
-    bottom, right = hi[0], hi[1]
-    ents = _axis_entries(mesh, x.shape)
-    row_axes, col_axes = ents[0], ents[1]
-    nr = math.prod(mesh.shape[a] for a in row_axes) if row_axes else 1
-    nc = math.prod(mesh.shape[a] for a in col_axes) if col_axes else 1
+    shape = x.shape
+    nd = len(shape)
+    los = tuple(-l for l in lo)  # halo widths below (per dim)
+    his = tuple(hi)
+    ents = _axis_entries(mesh, shape)
+    counts = [
+        math.prod(mesh.shape[a] for a in ents[d]) if ents[d] else 1
+        for d in range(nd)
+    ]
 
-    # pad to shard-divisible global shape (garbage rows/cols are masked)
-    Hp, Wp = -(-H // nr) * nr, -(-W // nc) * nc
-    if (Hp, Wp) != (H, W):
-        arrs = [jnp.pad(a, ((0, Hp - H), (0, Wp - W))) for a in arrs]
-    lh, lw = Hp // nr, Wp // nc
+    # pad to shard-divisible global shape (garbage cells are masked)
+    padded_shape = tuple(-(-shape[d] // counts[d]) * counts[d]
+                         for d in range(nd))
+    if padded_shape != shape:
+        pads = tuple((0, p - s) for p, s in zip(padded_shape, shape))
+        arrs = [jnp.pad(a, pads) for a in arrs]
+    local_shape = tuple(p // c for p, c in zip(padded_shape, counts))
 
     def local(*blocks):
-        # halo exchange: columns first, then rows of the column-extended
-        # block — corner halos arrive via the second exchange
+        # halo exchange dim by dim, last dim first; each later exchange
+        # sends the already-extended block, so corner halos ride along
         exts = []
         for b in blocks:
-            e = _exchange(b, 1, col_axes, nc, left, right)
-            e = _exchange(e, 0, row_axes, nr, top, bottom)
+            e = b
+            for d in range(nd - 1, -1, -1):
+                e = _exchange(e, d, ents[d], counts[d], los[d], his[d])
             exts.append(e)
-
-        r0 = (jax.lax.axis_index(row_axes) if row_axes else 0) * lh
-        c0 = (jax.lax.axis_index(col_axes) if col_axes else 0) * lw
 
         from ramba_tpu.ops import stencil_pallas
 
-        ih, iw = lh - (top + bottom), lw - (left + right)
+        inner = tuple(
+            local_shape[d] - (los[d] + his[d]) for d in range(nd)
+        )
         if (
             _OVERLAP
-            and ih > 0
-            and iw > 0
-            and (top or bottom or left or right)
+            and nd == 2
+            and all(i > 0 for i in inner)
+            and (any(los) or any(his))
             and not stencil_pallas.available_local(exts)
         ):
             # overlapped schedule: the interior strip depends only on the
@@ -160,23 +162,27 @@ def run(func, lo, hi, slots, arrs, taps):
             # workers computing while ZMQ receives land (ramba.py:
             # 3549-3780); here the latency-hiding scheduler does it.
             val = _overlapped_val(func, lo, hi, slots, blocks, exts,
-                                  (lh, lw))
+                                  local_shape)
         else:
-            val = _local_stencil(func, lo, hi, slots, exts, taps, (lh, lw))
-        gr = jax.lax.broadcasted_iota(jnp.int32, (lh, lw), 0) + r0
-        gc = jax.lax.broadcasted_iota(jnp.int32, (lh, lw), 1) + c0
-        valid = (gr >= top) & (gr < H - bottom) & (gc >= left) & (gc < W - right)
+            val = _local_stencil(func, lo, hi, slots, exts, taps,
+                                 local_shape)
+        valid = None
+        for d in range(nd):
+            off = (jax.lax.axis_index(ents[d]) if ents[d] else 0) \
+                * local_shape[d]
+            g = jax.lax.broadcasted_iota(jnp.int32, local_shape, d) + off
+            ok = (g >= los[d]) & (g < shape[d] - his[d])
+            valid = ok if valid is None else (valid & ok)
         return jnp.where(valid, val, jnp.zeros((), val.dtype))
 
-    spec = P(
-        row_axes[0] if len(row_axes) == 1 else (tuple(row_axes) or None),
-        col_axes[0] if len(col_axes) == 1 else (tuple(col_axes) or None),
-    )
+    spec = P(*(
+        (e[0] if len(e) == 1 else tuple(e)) if e else None for e in ents
+    ))
     out = jax.shard_map(
         local, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
     )(*arrs)
-    if (Hp, Wp) != (H, W):
-        out = out[:H, :W]
+    if padded_shape != shape:
+        out = out[tuple(slice(0, s) for s in shape)]
     return out
 
 
@@ -227,15 +233,15 @@ def _overlapped_val(func, lo, hi, slots, blocks, exts, shape):
 
 
 def _local_stencil(func, lo, hi, slots, exts, taps, interior):
-    """Stencil over a halo-extended local block; returns the (lh, lw)
+    """Stencil over a halo-extended local block; returns the local-shape
     interior values (no masking — the caller owns global-coordinate
-    masking)."""
+    masking).  Any rank; the Pallas kernel serves the 2-D case on TPU."""
     from ramba_tpu.ops import stencil_pallas
     from ramba_tpu.skeletons import stencil_interior
 
-    top, left = -lo[0], -lo[1]
-    lh, lw = interior
-    if stencil_pallas.available_local(exts):
+    if len(interior) == 2 and stencil_pallas.available_local(exts):
+        top, left = -lo[0], -lo[1]
+        lh, lw = interior
         try:
             full = stencil_pallas.run(func, lo, hi, slots, exts, taps)
             return jax.lax.slice(full, (top, left), (top + lh, left + lw))

@@ -448,19 +448,21 @@ def stencil_interior(func, lo, hi, slots, arrs):
 def _eval_stencil(static, *arrs):
     global _pallas_fallback_warned
     func, lo, hi, slots, taps = static
-    if len(arrs[0].shape) == 2:
-        from ramba_tpu.ops import stencil_pallas, stencil_sharded
+    from ramba_tpu.ops import stencil_sharded
 
-        if stencil_sharded.eligible(lo, hi, arrs):
-            try:
-                return stencil_sharded.run(func, lo, hi, slots, arrs, taps)
-            except Exception as e:  # same fence as the pallas path below
-                if not _pallas_fallback_warned:
-                    _pallas_fallback_warned = True
-                    warnings.warn(
-                        f"sharded stencil path unavailable, using GSPMD "
-                        f"shifted-slice path: {type(e).__name__}: {e}"
-                    )
+    if stencil_sharded.eligible(lo, hi, arrs):
+        try:
+            return stencil_sharded.run(func, lo, hi, slots, arrs, taps)
+        except Exception as e:  # same fence as the pallas path below
+            if not _pallas_fallback_warned:
+                _pallas_fallback_warned = True
+                warnings.warn(
+                    f"sharded stencil path unavailable, using GSPMD "
+                    f"shifted-slice path: {type(e).__name__}: {e}"
+                )
+    if len(arrs[0].shape) == 2:
+        from ramba_tpu.ops import stencil_pallas
+
         if stencil_pallas.available(arrs):
             try:
                 return stencil_pallas.run(func, lo, hi, slots, arrs, taps)

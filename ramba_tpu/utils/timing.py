@@ -151,3 +151,23 @@ def timing_summary(file=None) -> None:
 
 if common.timing_level > 0:
     atexit.register(timing_summary)
+
+
+@contextmanager
+def profiler_trace(logdir: str):
+    """Capture an XLA/TPU profiler trace of everything inside the block
+    (view with TensorBoard / xprof).  The TPU-native successor to the
+    reference's per-worker timer dumps (RAMBA_TIMING, ramba.py:355-420):
+    instead of wall-clock buckets per remote method, the trace shows each
+    fused module's device time, HBM traffic, and collective overlap."""
+    import jax
+
+    with jax.profiler.trace(logdir):
+        yield
+
+
+def annotate(label: str):
+    """Named region inside a profiler trace (device + host timeline)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(label)

@@ -57,7 +57,8 @@ class TestShardedStencil:
     def test_eligible_on_multichip_mesh(self):
         x = jnp.zeros((64, 64), jnp.float32)
         assert stencil_sharded.eligible((-2, -2), (2, 2), [x])
-        # 1-D array: not handled
+        # 1-D: handled when large enough to distribute
+        assert stencil_sharded.eligible((-1,), (1,), [jnp.zeros(4096)])
         assert not stencil_sharded.eligible((-1,), (1,), [jnp.zeros(64)])
         # tiny array below dist threshold: replicated, local compute
         assert not stencil_sharded.eligible(
@@ -183,3 +184,71 @@ class TestShardedStencil:
         x = np.random.RandomState(7).rand(48, 64).astype(np.float32)
         out = rt.sstencil(_star2(), rt.fromarray(x)).asarray()
         np.testing.assert_allclose(out, _star2_numpy(x), rtol=1e-5, atol=1e-6)
+
+
+class TestShardedStencilND:
+    """Explicit ppermute halos generalize to 1-D and 3-D stencils."""
+
+    def test_1d_stencil(self):
+        @rt.stencil
+        def avg3(a):
+            return (a[-1] + a[0] + a[1]) / 3.0
+
+        v = np.random.RandomState(10).rand(4096)
+        got = rt.sstencil(avg3, rt.fromarray(v)).asarray()
+        e = np.zeros_like(v)
+        e[1:-1] = (v[:-2] + v[1:-1] + v[2:]) / 3.0
+        np.testing.assert_allclose(got, e, rtol=1e-9)
+
+    def test_1d_dispatches_sharded(self, monkeypatch):
+        calls = {"n": 0}
+        real = stencil_sharded.run
+
+        def spy(*a, **k):
+            calls["n"] += 1
+            return real(*a, **k)
+
+        monkeypatch.setattr(stencil_sharded, "run", spy)
+
+        @rt.stencil
+        def diff(a):
+            return a[1] - a[-1]
+
+        v = np.random.RandomState(11).rand(2048)
+        got = rt.sstencil(diff, rt.fromarray(v)).asarray()
+        assert calls["n"] >= 1
+        e = np.zeros_like(v)
+        e[1:-1] = v[2:] - v[:-2]
+        np.testing.assert_allclose(got, e, rtol=1e-9)
+
+    def test_3d_stencil(self):
+        @rt.stencil
+        def seven(a):
+            return a[0, 0, 0] + (
+                a[-1, 0, 0] + a[1, 0, 0] + a[0, -1, 0]
+                + a[0, 1, 0] + a[0, 0, -1] + a[0, 0, 1]
+            ) / 6.0
+
+        v = np.random.RandomState(12).rand(16, 24, 12)
+        got = rt.sstencil(seven, rt.fromarray(v)).asarray()
+        e = np.zeros_like(v)
+        c = v[1:-1, 1:-1, 1:-1]
+        e[1:-1, 1:-1, 1:-1] = c + (
+            v[:-2, 1:-1, 1:-1] + v[2:, 1:-1, 1:-1]
+            + v[1:-1, :-2, 1:-1] + v[1:-1, 2:, 1:-1]
+            + v[1:-1, 1:-1, :-2] + v[1:-1, 1:-1, 2:]
+        ) / 6.0
+        np.testing.assert_allclose(got, e, rtol=1e-9)
+
+    def test_3d_odd_shapes(self):
+        @rt.stencil
+        def st(a):
+            return a[-1, 0, 1] + a[1, -1, 0]
+
+        v = np.random.RandomState(13).rand(9, 13, 7)
+        got = rt.sstencil(st, rt.fromarray(v)).asarray()
+        # lo=(-1,-1,0), hi=(1,0,1): valid i in [1,n0-1), j in [1,n1),
+        # k in [0,n2-1)
+        e = np.zeros_like(v)
+        e[1:-1, 1:, :-1] = v[:-2, 1:, 1:] + v[2:, :-1, :-1]
+        np.testing.assert_allclose(got, e, rtol=1e-9)
