@@ -129,3 +129,71 @@ def test_random_program(seed):
 def test_random_program_wide(block):
     for seed in range(40 + block * 46, 40 + (block + 1) * 46):
         _check(seed)
+
+
+# ---------------------------------------------------------------------------
+# Mutation + manipulation fuzz: setitem, masked writes, fancy indexing,
+# concatenate/stack/pad/roll/sort/take — the reference's other test axis
+# (test_distributed_array.py drives slicing/assignment heavily).
+# ---------------------------------------------------------------------------
+
+
+def _gen_mut_program(seed):
+    rng = np.random.RandomState(seed)
+    base = rng.rand(8, 10)
+    steps = []
+    for _ in range(rng.randint(3, 8)):
+        c = rng.randint(7)
+        if c == 0:  # basic setitem
+            r = rng.randint(8)
+            steps.append(("set_row", (r, rng.rand(10))))
+        elif c == 1:  # masked write
+            steps.append(("masked_add", float(rng.rand())))
+        elif c == 2:  # fancy get
+            steps.append(("fancy_get", tuple(rng.randint(0, 8, size=3))))
+        elif c == 3:  # fancy set
+            steps.append(("fancy_set",
+                          (tuple(rng.randint(0, 8, size=2)), float(rng.rand()))))
+        elif c == 4:
+            steps.append(("roll", int(rng.randint(-5, 6))))
+        elif c == 5:
+            steps.append(("concat_self", None))
+        else:
+            steps.append(("take", tuple(rng.randint(0, 10, size=4))))
+    return base, steps
+
+
+def _run_mut(app, base, steps):
+    a = app.asarray(base.copy())
+    outs = []
+    for kind, payload in steps:
+        if kind == "set_row":
+            r, v = payload
+            a[r] = v
+        elif kind == "masked_add":
+            a[a > payload] += 1.0
+        elif kind == "fancy_get":
+            outs.append(np.asarray(a[np.asarray(payload)]))
+        elif kind == "fancy_set":
+            rows, val = payload
+            a[np.asarray(rows)] = val
+        elif kind == "roll":
+            outs.append(np.asarray(app.roll(a, payload, axis=1)))
+        elif kind == "concat_self":
+            outs.append(np.asarray(app.concatenate([a, a], axis=0)))
+        else:
+            outs.append(np.asarray(app.take(a, np.asarray(payload), axis=1)))
+    outs.append(np.asarray(a))
+    return outs
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_mutation_program(seed):
+    base, steps = _gen_mut_program(seed)
+    want = _run_mut(np, base, steps)
+    got = _run_mut(rt, base, steps)
+    assert len(want) == len(got)
+    for k, (w, g) in enumerate(zip(want, got)):
+        assert g.shape == w.shape and g.dtype == w.dtype, (seed, k)
+        np.testing.assert_allclose(g, w, rtol=1e-12,
+                                   err_msg=f"value {k} (seed {seed})")
