@@ -19,9 +19,9 @@ Design (pallas_guide.md patterns):
   match sstencil's semantics (the reference writes only indices whose full
   neighborhood is in range).
 
-Multi-chip stencils stay on the GSPMD path (XLA inserts the halo
-collective-permutes); fusing this kernel into a shard_map with explicit
-ppermute halos is the planned next step.
+Multi-chip stencils run through ops/stencil_sharded.py (shard_map +
+explicit ppermute halo exchange), which calls back into this kernel on
+each shard's halo-extended local block via ``available_local``/``run``.
 """
 
 from __future__ import annotations
