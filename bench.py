@@ -155,7 +155,9 @@ def main():
         # failure appeared, at the VMEM-derived block height it implies).
         # On failure, disable pallas so the stencil section below still
         # records an XLA-path number instead of dying on the same error.
-        if platform == "tpu":
+        # Gate on "not cpu" rather than == "tpu": the axon tunnel may
+        # surface the chip under its own platform name.
+        if platform != "cpu":
             try:
                 import os
 
