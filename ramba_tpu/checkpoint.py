@@ -25,8 +25,12 @@ from ramba_tpu.core.fuser import flush
 from ramba_tpu.core.ndarray import ndarray
 
 
-def save(path: str, tree, *, force: bool = True) -> None:
-    """Write a pytree of framework arrays (device-direct, sharded)."""
+def save(path: str, tree, *, force: bool = False) -> None:
+    """Write a pytree of framework arrays (device-direct, sharded).
+
+    ``force=False`` (Orbax's own safe default) errors if ``path`` already
+    holds a checkpoint instead of deleting it; pass ``force=True`` to
+    overwrite deliberately."""
     import orbax.checkpoint as ocp
 
     flush()

@@ -197,19 +197,8 @@ def _shard_chunks(arr):
     one shard per step.  Falls back to one whole-array chunk for plain
     hosts arrays."""
     if isinstance(arr, ndarray):
-        import jax
-
         from ramba_tpu.core.fuser import flush
 
-        if jax.process_count() > 1:
-            # multi-controller: each process sees only its own shards, and
-            # every process would truncate the same file — refuse loudly
-            # rather than write a silently partial one
-            raise NotImplementedError(
-                "save() under multi-controller execution is not supported "
-                "yet: gather to the driver (np.asarray of a replicated "
-                "array) or write per-process files"
-            )
         flush()
         v = arr._value()
         seen = set()
@@ -242,6 +231,17 @@ def save(path: str, arr) -> None:
     has no save path at all — SURVEY §5 notes this gap).  Distributed
     arrays are written one shard at a time into a preallocated on-disk
     target, so host memory is bounded by the largest shard."""
+    import jax
+
+    if jax.process_count() > 1:
+        # multi-controller: each process sees only its own shards, and
+        # every process would truncate the same file.  Refuse BEFORE any
+        # file is created/truncated so an existing file survives.
+        raise NotImplementedError(
+            "save() under multi-controller execution is not supported "
+            "yet: gather to the driver (np.asarray of a replicated "
+            "array) or write per-process files"
+        )
     ext = os.path.splitext(path)[1].lower().lstrip(".")
     shape, dtype = _arr_meta(arr)
     if ext == "npy":
