@@ -339,6 +339,13 @@ def _op_reduce_where(static, x, mask):
 @defop("cumulative")
 def _op_cumulative(static, x):
     fname, axis = static
+    # numpy promotes sub-word integer scans to the platform int (int64
+    # under x64), same as sum/prod; jnp keeps the input dtype
+    kind = jnp.dtype(x.dtype).kind
+    if jax.config.jax_enable_x64 and kind in "biu":
+        want = {"b": jnp.int64, "i": jnp.int64, "u": jnp.uint64}[kind]
+        if jnp.dtype(x.dtype).itemsize < 8:
+            x = x.astype(want)
     return getattr(jnp, fname)(x, axis=axis)
 
 

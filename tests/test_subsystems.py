@@ -616,3 +616,39 @@ class TestDtypePromotionParity:
         r = (a / rt.fromarray(np.array([2, 4, 2], np.int32))).asarray()
         assert r.dtype == np.float64
         np.testing.assert_allclose(r, [0.5, 0.5, 3.5])
+
+class TestViewAliasingEdges:
+    """Write-through across gnarly view chains (reference: views share a
+    gid and all writes land in the base shards, ramba.py:5545-5565)."""
+
+    @pytest.mark.parametrize("name,mutate", [
+        ("neg step write",
+         lambda a: a[::-1].__setitem__((0, slice(None)), 99.0)),
+        ("reshape view write",
+         lambda a: a.reshape(6, 4).__setitem__((2, slice(None)), -1.0)),
+        ("chained view write", lambda a: a[1:][1:].__setitem__(0, 5.0)),
+        ("transpose slice iadd", lambda a: a.T[2:4].__iadd__(10.0)),
+        ("ravel write",
+         lambda a: a.reshape(-1).__setitem__(slice(3, 9), 0.0)),
+        ("col neg step imul", lambda a: a[:, ::-2].__imul__(2.0)),
+        ("newaxis write",
+         lambda a: a[:, None, :].__setitem__((1, 0, slice(None)), 7.0)),
+    ])
+    def test_write_through(self, name, mutate):
+        w = np.arange(24.0).reshape(4, 6)
+        g = rt.fromarray(w.copy())
+        mutate(w)
+        mutate(g)
+        np.testing.assert_allclose(np.asarray(g), w, err_msg=name)
+
+
+class TestCumulativePromotion:
+    def test_small_int_scans_widen(self):
+        # numpy: cumsum/cumprod of sub-word ints promote to int64/uint64
+        for dt in (np.int8, np.int16, np.int32, np.uint8, np.bool_):
+            a = np.ones(10, dtype=dt)
+            for op in ("cumsum", "cumprod"):
+                w = getattr(np, op)(a)
+                g = getattr(rt, op)(rt.fromarray(a)).asarray()
+                assert g.dtype == w.dtype, (op, dt, g.dtype, w.dtype)
+                np.testing.assert_array_equal(g, w)
