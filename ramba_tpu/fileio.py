@@ -226,6 +226,123 @@ def _arr_meta(arr):
     return tuple(a.shape), np.dtype(a.dtype)
 
 
+# ---------------------------------------------------------------------------
+# Sharded directory format (.rtd): per-shard .npy files + JSON manifests.
+#
+# The multi-controller answer to single-file save: every process writes
+# ONLY the shards it owns (plus its own manifest part), so no cross-process
+# coordination is needed; load reassembles arbitrary regions from the shard
+# boxes, so the reading mesh may differ from the writing mesh.  This is the
+# TPU-native equivalent of the reference's per-worker shard I/O
+# (/root/reference/ramba/ramba.py:3929-3956).
+# ---------------------------------------------------------------------------
+
+
+def _save_rtd(path: str, arr) -> None:
+    import json
+
+    import jax
+
+    from ramba_tpu.core.fuser import flush
+
+    if not isinstance(arr, ndarray):
+        arr = fromarray(np.asarray(arr))
+    flush()
+    v = arr._value()
+    os.makedirs(path, exist_ok=True)
+    pid = jax.process_index()
+    local_devs = set(jax.local_devices())
+    shard_by_dev = {s.device: s for s in v.addressable_shards}
+
+    def box(idx):
+        return tuple(
+            (int(sl.start or 0),
+             int(sl.stop) if sl.stop is not None else int(dim))
+            for sl, dim in zip(idx, v.shape)
+        )
+
+    # deterministic global winner per replicated box: the first device in
+    # devices_indices_map order claims it — every process computes the
+    # same assignment, each writes only its local winners
+    seen = set()
+    entries = []
+    for dev, idx in v.sharding.devices_indices_map(v.shape).items():
+        b = box(idx)
+        if b in seen:
+            continue
+        seen.add(b)
+        if dev not in local_devs:
+            continue
+        fname = f"shard_p{pid}_{len(entries)}.npy"
+        chunk = np.asarray(shard_by_dev[dev].data)
+        io_stats["chunks"] += 1
+        io_stats["max_chunk_bytes"] = max(io_stats["max_chunk_bytes"],
+                                          chunk.nbytes)
+        with open(os.path.join(path, fname), "wb") as f:
+            np.save(f, chunk)
+        entries.append({"file": fname,
+                        "start": [lo for lo, _ in b],
+                        "stop": [hi for _, hi in b]})
+    with open(os.path.join(path, f"manifest.p{pid}.json"), "w") as f:
+        json.dump(
+            {"shape": list(v.shape), "dtype": np.dtype(v.dtype).name,
+             "shards": entries},
+            f,
+        )
+
+
+def _load_rtd(path: str, key=None) -> ndarray:
+    import glob
+    import json
+
+    parts = sorted(glob.glob(os.path.join(path, "manifest.p*.json")))
+    if not parts:
+        raise FileNotFoundError(f"no .rtd manifests under {path!r}")
+    shards = []
+    shape = dtype = None
+    for p in parts:
+        with open(p) as f:
+            m = json.load(f)
+        shape = tuple(m["shape"])
+        dtype = np.dtype(m["dtype"])
+        for e in m["shards"]:
+            shards.append((tuple(e["start"]), tuple(e["stop"]),
+                           os.path.join(path, e["file"])))
+
+    def read_slice(index):
+        sel = tuple(
+            (int(sl.start or 0),
+             int(sl.stop) if sl.stop is not None else int(dim))
+            for sl, dim in zip(index, shape)
+        )
+        out = np.empty(tuple(hi - lo for lo, hi in sel), dtype)
+        filled = 0
+        for start, stop, fname in shards:
+            lo = tuple(max(a, s) for (a, _), s in zip(sel, start))
+            hi = tuple(min(b, t) for (_, b), t in zip(sel, stop))
+            if any(l >= h for l, h in zip(lo, hi)):
+                continue
+            m = np.load(fname, mmap_mode="r")
+            dst = tuple(slice(l - a, h - a)
+                        for (a, _), l, h in zip(sel, lo, hi))
+            src = tuple(slice(l - s, h - s)
+                        for s, l, h in zip(start, lo, hi))
+            out[dst] = m[src]
+            filled += int(np.prod([h - l for l, h in zip(lo, hi)]))
+        want = int(np.prod([hi - lo for lo, hi in sel]))
+        if filled < want:
+            raise ValueError(
+                f"rtd checkpoint {path!r} does not cover region {sel} "
+                f"(covered {filled}/{want} elements — incomplete save?)"
+            )
+        return out
+
+    return _sharded_from_reader(shape, dtype, read_slice)
+
+
+register_loader(["rtd"], _load_rtd)
+
+
 def save(path: str, arr) -> None:
     """Chunked save, dispatched by extension like ``load`` (the reference
     has no save path at all — SURVEY §5 notes this gap).  Distributed
@@ -233,16 +350,21 @@ def save(path: str, arr) -> None:
     target, so host memory is bounded by the largest shard."""
     import jax
 
-    if jax.process_count() > 1:
-        # multi-controller: each process sees only its own shards, and
-        # every process would truncate the same file.  Refuse BEFORE any
-        # file is created/truncated so an existing file survives.
-        raise NotImplementedError(
-            "save() under multi-controller execution is not supported "
-            "yet: gather to the driver (np.asarray of a replicated "
-            "array) or write per-process files"
-        )
     ext = os.path.splitext(path)[1].lower().lstrip(".")
+    if ext == "rtd":
+        # sharded directory format: multi-controller safe (each process
+        # writes only its own shards + manifest part)
+        return _save_rtd(path, arr)
+    if jax.process_count() > 1:
+        # multi-controller single-file save: each process sees only its
+        # own shards, and every process would truncate the same file.
+        # Refuse BEFORE any file is created/truncated so an existing file
+        # survives.
+        raise NotImplementedError(
+            "single-file save() under multi-controller execution is not "
+            "supported: use the sharded directory format (save to a "
+            "'.rtd' path) or gather to the driver first"
+        )
     shape, dtype = _arr_meta(arr)
     if ext == "npy":
         # open_memmap writes the .npy header then exposes the data region;

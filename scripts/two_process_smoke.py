@@ -61,6 +61,24 @@ def worker(rank: int, port: int) -> None:
     s = float(rt.sum(a))
     assert s == n * (n - 1) / 2, s
 
+    # sharded-directory save/load across the process boundary: each
+    # process writes its own shards + manifest (synchronous host writes),
+    # a collective acts as the barrier, then both reassemble the array
+    rtd = os.environ["RAMBA_TPU_SMOKE_RTD"]
+    big = rt.arange(n, dtype=float) * 3.0
+    rt.save(rtd, big)
+    float(rt.sum(rt.ones(256)))  # collective: all shards written
+    back = rt.load(rtd)
+    diff = float(rt.sum((back - big) * (back - big)))
+    assert diff == 0.0, diff
+
+    # single-file save must refuse loudly under multi-controller
+    try:
+        rt.save(os.path.join(os.path.dirname(rtd), "nope.npy"), big)
+        raise AssertionError("single-file save should have refused")
+    except NotImplementedError:
+        pass
+
     # driver gating (reference: in_driver() in MPI SPMD mode)
     if distributed.in_driver():
         assert rank == 0
@@ -75,9 +93,14 @@ def launch() -> int:
     with socket.socket() as s:
         s.bind(("localhost", 0))
         port = s.getsockname()[1]
+    import tempfile
+
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO  # drop site hooks that force a TPU backend
     env.pop("JAX_PLATFORMS", None)
+    env["RAMBA_TPU_SMOKE_RTD"] = os.path.join(
+        tempfile.mkdtemp(prefix="rtd_smoke_"), "arr.rtd"
+    )
     procs = [
         subprocess.Popen(
             [sys.executable, "-u", os.path.abspath(__file__),
