@@ -245,12 +245,20 @@ def _save_rtd(path: str, arr) -> None:
 
     from ramba_tpu.core.fuser import flush
 
+    import glob
+
     if not isinstance(arr, ndarray):
         arr = fromarray(np.asarray(arr))
     flush()
     v = arr._value()
     os.makedirs(path, exist_ok=True)
     pid = jax.process_index()
+    # clear THIS process's stale files from any earlier save (other
+    # processes own — and clear — their own; saves with a different
+    # process count are caught at load time via the recorded nproc)
+    for stale in glob.glob(os.path.join(path, f"shard_p{pid}_*.npy")) + \
+            glob.glob(os.path.join(path, f"manifest.p{pid}.json")):
+        os.remove(stale)
     local_devs = set(jax.local_devices())
     shard_by_dev = {s.device: s for s in v.addressable_shards}
 
@@ -286,7 +294,7 @@ def _save_rtd(path: str, arr) -> None:
     with open(os.path.join(path, f"manifest.p{pid}.json"), "w") as f:
         json.dump(
             {"shape": list(v.shape), "dtype": np.dtype(v.dtype).name,
-             "shards": entries},
+             "nproc": jax.process_count(), "shards": entries},
             f,
         )
 
@@ -299,15 +307,30 @@ def _load_rtd(path: str, key=None) -> ndarray:
     if not parts:
         raise FileNotFoundError(f"no .rtd manifests under {path!r}")
     shards = []
-    shape = dtype = None
+    shape = dtype = nproc = None
     for p in parts:
         with open(p) as f:
             m = json.load(f)
-        shape = tuple(m["shape"])
-        dtype = np.dtype(m["dtype"])
+        meta = (tuple(m["shape"]), np.dtype(m["dtype"]),
+                int(m.get("nproc", 1)))
+        if shape is None:
+            shape, dtype, nproc = meta
+        elif (shape, dtype, nproc) != meta:
+            raise ValueError(
+                f"inconsistent .rtd manifests under {path!r}: {meta} vs "
+                f"{(shape, dtype, nproc)} — mixed saves in one directory?"
+            )
         for e in m["shards"]:
             shards.append((tuple(e["start"]), tuple(e["stop"]),
                            os.path.join(path, e["file"])))
+    if len(parts) != nproc:
+        raise ValueError(
+            f".rtd checkpoint {path!r} was written by {nproc} processes "
+            f"but {len(parts)} manifest parts are present — stale or "
+            f"incomplete save"
+        )
+
+    mmaps: dict = {}  # one open per shard file per load, not per region
 
     def read_slice(index):
         sel = tuple(
@@ -316,24 +339,26 @@ def _load_rtd(path: str, key=None) -> ndarray:
             for sl, dim in zip(index, shape)
         )
         out = np.empty(tuple(hi - lo for lo, hi in sel), dtype)
-        filled = 0
+        covered = np.zeros(out.shape, bool)  # exact: overlaps don't fool it
         for start, stop, fname in shards:
             lo = tuple(max(a, s) for (a, _), s in zip(sel, start))
             hi = tuple(min(b, t) for (_, b), t in zip(sel, stop))
             if any(l >= h for l, h in zip(lo, hi)):
                 continue
-            m = np.load(fname, mmap_mode="r")
+            if fname not in mmaps:
+                mmaps[fname] = np.load(fname, mmap_mode="r")
+            m = mmaps[fname]
             dst = tuple(slice(l - a, h - a)
                         for (a, _), l, h in zip(sel, lo, hi))
             src = tuple(slice(l - s, h - s)
                         for s, l, h in zip(start, lo, hi))
             out[dst] = m[src]
-            filled += int(np.prod([h - l for l, h in zip(lo, hi)]))
-        want = int(np.prod([hi - lo for lo, hi in sel]))
-        if filled < want:
+            covered[dst] = True
+        if not covered.all():
             raise ValueError(
                 f"rtd checkpoint {path!r} does not cover region {sel} "
-                f"(covered {filled}/{want} elements — incomplete save?)"
+                f"({int(covered.sum())}/{covered.size} elements covered "
+                f"— incomplete save?)"
             )
         return out
 

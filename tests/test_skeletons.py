@@ -743,3 +743,26 @@ class TestRtdShardedFormat:
             p = str(tmp_path / f"d{len(shape)}.rtd")
             rt.save(p, rt.fromarray(v))
             np.testing.assert_allclose(rt.load(p).asarray(), v)
+
+    def test_resave_replaces_cleanly(self, tmp_path):
+        # a second save to the same path must not merge with stale shards
+        p = str(tmp_path / "e.rtd")
+        rt.save(p, rt.fromarray(np.ones((64, 64))))
+        v2 = np.random.RandomState(3).rand(128, 32)
+        rt.save(p, rt.fromarray(v2))
+        back = rt.load(p)
+        assert back.shape == (128, 32)
+        np.testing.assert_allclose(back.asarray(), v2)
+
+    def test_stale_foreign_manifest_detected(self, tmp_path):
+        # a manifest part from a save with a different process count must
+        # refuse at load (the stale-merge hazard of partial overwrites)
+        import json
+
+        p = str(tmp_path / "f.rtd")
+        rt.save(p, rt.fromarray(np.ones((64, 64))))
+        with open(p + "/manifest.p7.json", "w") as f:
+            json.dump({"shape": [64, 64], "dtype": "float64", "nproc": 1,
+                       "shards": []}, f)
+        with pytest.raises(ValueError, match="manifest parts"):
+            rt.load(p).asarray()
