@@ -145,3 +145,21 @@ class TestXarrayInterop:
         np.testing.assert_allclose(
             got, _pandas_climatology(x, labels), rtol=1e-9
         )
+
+
+class TestShardedLabels:
+    def test_groupby_with_distributed_label_array(self):
+        """Labels big enough to shard (the reference ships label arrays to
+        workers as distributed arrays, test_groupby.py coord_days)."""
+        n = 4096
+        x = np.random.RandomState(6).rand(4, n)
+        labels_np = (np.arange(n) * 7) % 12
+        labels = rt.fromarray(labels_np.astype(np.int32))
+        gb = rt.fromarray(x).groupby(1, labels, num_groups=12)
+        got = gb.mean().asarray()
+        want = np.stack(
+            [x[:, labels_np == g].mean(axis=1) for g in range(12)], axis=1
+        )
+        np.testing.assert_allclose(got, want, rtol=1e-10)
+        anom = (gb - gb.mean()).asarray()
+        np.testing.assert_allclose(anom, x - want[:, labels_np], rtol=1e-9)
