@@ -225,6 +225,16 @@ def _np_loop_dtypes(fname, args):
 @defop("map")
 def _op_map(static, *args):
     (fname,) = static
+    if fname == "where" and len(args) == 3 and jax.config.jax_enable_x64:
+        # np.where is not a ufunc; its value operands take the numpy
+        # common dtype (NEP 50)
+        want = _np_loop_dtypes("add", args[1:])
+        if want is not None:
+            a2 = args[1] if getattr(args[1], "dtype", None) == want[-1] \
+                else jnp.asarray(args[1], want[-1])
+            a3 = args[2] if getattr(args[2], "dtype", None) == want[-1] \
+                else jnp.asarray(args[2], want[-1])
+            return jnp.where(args[0], a2, a3)
     loop = _np_loop_dtypes(fname, args)
     if loop is not None:
         # cast INPUTS to numpy's loop dtypes (computing in the wider type,
@@ -458,15 +468,33 @@ def _op_flip(static, x):
 # -- structural --------------------------------------------------------------
 
 
+def _np_common_dtype(args):
+    """numpy's NEP-50 common dtype for a join of arrays, or None when jax
+    promotion should stand (x64 off, or unresolvable)."""
+    if not jax.config.jax_enable_x64:
+        return None
+    try:
+        want = np.result_type(*[np.dtype(a.dtype) for a in args])
+    except Exception:
+        return None
+    return want
+
+
 @defop("concatenate")
 def _op_concatenate(static, *args):
     (axis,) = static
+    want = _np_common_dtype(args)
+    if want is not None:
+        args = [a.astype(want) if a.dtype != want else a for a in args]
     return jnp.concatenate(args, axis=axis)
 
 
 @defop("stack")
 def _op_stack(static, *args):
     (axis,) = static
+    want = _np_common_dtype(args)
+    if want is not None:
+        args = [a.astype(want) if a.dtype != want else a for a in args]
     return jnp.stack(args, axis=axis)
 
 

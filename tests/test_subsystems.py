@@ -652,3 +652,23 @@ class TestCumulativePromotion:
                 g = getattr(rt, op)(rt.fromarray(a)).asarray()
                 assert g.dtype == w.dtype, (op, dt, g.dtype, w.dtype)
                 np.testing.assert_array_equal(g, w)
+
+class TestJoinPromotionParity:
+    def test_concat_stack_where_mixed_dtypes(self):
+        i = np.ones(4, np.int32)
+        f = np.ones(4, np.float32)
+        for name, fn in [
+            ("concat", lambda ap: ap.concatenate(
+                [ap.asarray(i), ap.asarray(f)])),
+            ("stack", lambda ap: ap.stack(
+                [ap.asarray(i), ap.asarray(f)])),
+            ("where", lambda ap: ap.where(
+                ap.asarray(i) > 0, ap.asarray(i), ap.asarray(f))),
+        ]:
+            w = np.asarray(fn(np))
+            g = np.asarray(fn(rt))
+            assert g.dtype == w.dtype, (name, g.dtype, w.dtype)
+            np.testing.assert_allclose(g, w)
+        # weak scalar in where keeps the array dtype (NEP 50)
+        r = rt.where(rt.fromarray(f) > 0, rt.fromarray(f), 0.0).asarray()
+        assert r.dtype == np.float32
