@@ -54,9 +54,9 @@ from ramba_tpu.ops.manipulation import (  # noqa: F401
 )
 from ramba_tpu.ops.extras import (  # noqa: F401
     append, argwhere, bincount, compress, convolve, corrcoef, correlate, cov,
-    cross, delete, diff, digitize, ediff1d, extract, flatnonzero, gradient,
-    histogram, in1d, insert, interp, intersect1d, isin, kron, nan_to_num,
-    nonzero, searchsorted, setdiff1d, union1d, unique, unwrap,
+    cross, delete, diff, digitize, divmod, ediff1d, extract, flatnonzero,
+    gradient, histogram, in1d, insert, interp, intersect1d, isin, kron, modf,
+    nan_to_num, nonzero, searchsorted, setdiff1d, union1d, unique, unwrap,
 )
 from ramba_tpu.ops.linalg import (  # noqa: F401
     dot, einsum, inner, matmul, outer, set_matmul_precision, tensordot,

@@ -215,3 +215,19 @@ def histogram(a, bins=10, range=None, weights=None, density=None):
     w = _host(weights) if weights is not None else None
     return np.histogram(_host(a), bins=bins, range=range, weights=w,
                         density=density)
+
+
+def modf(x):
+    """numpy.modf: (fractional, integral) parts, both with x's sign."""
+    x = asarray(x)
+    from ramba_tpu.ops.elementwise import trunc
+
+    ip = trunc(x)
+    return x - ip, ip
+
+
+def divmod(a, b):  # noqa: A001 - numpy name
+    """numpy.divmod: elementwise (floor_divide, mod)."""
+    from ramba_tpu.ops.elementwise import floor_divide, mod
+
+    return floor_divide(a, b), mod(a, b)

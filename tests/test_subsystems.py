@@ -672,3 +672,19 @@ class TestJoinPromotionParity:
         # weak scalar in where keeps the array dtype (NEP 50)
         r = rt.where(rt.fromarray(f) > 0, rt.fromarray(f), 0.0).asarray()
         assert r.dtype == np.float32
+
+class TestModfDivmod:
+    def test_modf(self):
+        v = np.array([1.7, -2.3, 0.5, -0.0])
+        wf, wi = np.modf(v)
+        gf, gi = rt.modf(rt.fromarray(v))
+        np.testing.assert_allclose(gf.asarray(), wf)
+        np.testing.assert_allclose(gi.asarray(), wi)
+
+    def test_divmod(self):
+        a = np.array([7, -7, 9])
+        b = np.array([3, 3, -4])
+        wq, wr = np.divmod(a, b)
+        gq, gr = rt.divmod(rt.fromarray(a), rt.fromarray(b))
+        np.testing.assert_array_equal(gq.asarray(), wq)
+        np.testing.assert_array_equal(gr.asarray(), wr)
