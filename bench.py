@@ -22,9 +22,119 @@ ONE JSON line, always.
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 import traceback
+
+# ---------------------------------------------------------------------------
+# Backend bring-up (round-4 hardening).
+#
+# Round-3 postmortem: `jax.devices()` raised `Unable to initialize backend
+# 'axon': UNAVAILABLE` before any benchmark section ran, and nothing retried
+# — three rounds with no TPU number.  Two failure modes exist:
+#   * the backend init *raises* (driver environment, BENCH_r03), or
+#   * it *hangs* (builder container: the relay claim leg spins forever).
+# An in-process hang cannot be recovered (the stuck call is in C), so the
+# probe runs in a subprocess with a timeout.  The first candidate that can
+# run a tiny computation wins; the parent then selects the same platform via
+# `jax.config.update("jax_platforms", ...)` — NOT the env var, which the
+# axon site-hook's register() overrides.  If everything fails we still bench
+# on CPU and record the errors, so the JSON always carries a number.
+# ---------------------------------------------------------------------------
+
+_PROBE_SRC = """
+import sys
+sel = sys.argv[1]
+import jax
+if sel != "default":
+    jax.config.update("jax_platforms", sel)
+d = jax.devices()
+import jax.numpy as jnp
+x = float(jnp.arange(8.0).sum())
+assert x == 28.0, x
+print("PROBE_OK", d[0].platform, flush=True)
+"""
+
+
+def _probe(sel, timeout_s):
+    """Try backend candidate ``sel`` in a subprocess.  Returns
+    (platform|None, error|None).  ``sel``: "default" = whatever the site
+    hook configured (axon on the TPU image), "" = jax auto-choose,
+    "cpu" = host fallback."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC, sel],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"probe {sel or 'auto'}: timed out after {timeout_s:.0f}s"
+    except Exception as e:  # noqa: BLE001
+        return None, f"probe {sel or 'auto'}: {e!r}"
+    for ln in (r.stdout or "").splitlines():
+        if ln.startswith("PROBE_OK"):
+            return ln.split()[1], None
+    err_lines = ((r.stderr or "") + (r.stdout or "")).strip().splitlines()
+    return None, (
+        f"probe {sel or 'auto'}: rc={r.returncode} "
+        + " | ".join(err_lines[-3:])[-300:]
+    )
+
+
+def _bring_up(out):
+    """Pick a working backend.  Returns the jax_platforms value for the
+    parent ("default" = leave the site-hook's selection in place)."""
+    budget = float(os.environ.get("RAMBA_BENCH_INIT_TIMEOUT", "240"))
+    # Two shots at the named TPU backend (r02 proved the chip *can* come
+    # up; r03's UNAVAILABLE may be transient), then jax auto-choose, then
+    # CPU so a number is always produced.
+    attempts = [
+        ("default", budget),
+        ("default", max(budget / 2, 60)),
+        ("", max(budget / 4, 60)),
+        ("cpu", 120),
+    ]
+    errors = []
+    for i, (sel, tmo) in enumerate(attempts):
+        plat, err = _probe(sel, tmo)
+        if plat is not None:
+            if errors:
+                out["tpu_init_error"] = " ;; ".join(errors)[-800:]
+            out["backend_selected_via"] = sel or "auto"
+            if sel != "cpu" and i > 0:
+                time.sleep(5)  # let the probe's device claim release
+            return sel
+        errors.append(err)
+        time.sleep(5 if i < 2 else 1)
+    out["tpu_init_error"] = " ;; ".join(errors)[-800:]
+    out["backend_selected_via"] = "cpu-last-resort"
+    return "cpu"
+
+
+def _devices_with_recovery(jax, out):
+    """jax.devices() with the clear-backends retry recipe
+    (same as __graft_entry__.dryrun_multichip) — in-process insurance on
+    top of the subprocess probe."""
+    try:
+        return jax.devices()
+    except Exception as e:  # noqa: BLE001
+        out["tpu_init_error"] = (
+            out.get("tpu_init_error", "") + f" ;; in-proc: {e!r}"[:300]
+        )
+    import jax.extend.backend as jeb
+
+    for sel in ("", "cpu"):
+        try:
+            jax.clear_caches()
+            jeb.clear_backends()
+            jax.config.update("jax_platforms", sel)
+            return jax.devices()
+        except Exception as e:  # noqa: BLE001
+            out["tpu_init_error"] += f" ;; retry {sel or 'auto'}: {e!r}"[:300]
+    raise RuntimeError("no usable jax backend (tpu and cpu both failed)")
 
 
 def _bench_chain(rt, n):
@@ -139,11 +249,16 @@ def main():
         "vs_baseline": None,
     }
     try:
+        sel = _bring_up(out)
+
         import jax
+
+        if sel != "default":
+            jax.config.update("jax_platforms", sel)
 
         import ramba_tpu as rt
 
-        platform = jax.devices()[0].platform
+        platform = _devices_with_recovery(jax, out)[0].platform
         out["platform"] = platform
         n = 1_000_000_000
         if platform == "cpu":  # debug/dry-run environments
