@@ -66,8 +66,8 @@ from ramba_tpu.parallel.mesh import (  # noqa: F401
     get_mesh, num_workers, set_mesh,
 )
 from ramba_tpu.skeletons import (  # noqa: F401
-    SreduceReducer, barrier, scumulative, smap, smap_index, spmd, sreduce,
-    sreduce_index, sstencil, stencil, worker_id,
+    KernelTraceError, SreduceReducer, barrier, scumulative, smap, smap_index,
+    spmd, sreduce, sreduce_index, sstencil, stencil, worker_id,
 )
 from ramba_tpu.groupby import RambaGroupby  # noqa: F401
 from ramba_tpu.fileio import Dataset, load, register_loader, save  # noqa: F401
