@@ -64,11 +64,19 @@ class MaskedArray(ndarray):
 
         axis = _norm_axis(axis, self.ndim)
         if fname in ("var", "std"):
-            # two-pass via masked mean
+            # two-pass via masked mean; ddof rescales by n/(n-ddof) with
+            # n = selected count per reduction slice (numpy.ma semantics)
             m = self._reduce("mean", axis, True)
             d = (ndarray(self.read_expr()) - m)
             sq = d * d
             v = MaskedArray(sq, self._mask)._reduce("mean", axis, keepdims)
+            if ddof:
+                from ramba_tpu.ops.elementwise import where
+
+                cnt = self._mask.sum(axis=axis, keepdims=keepdims)
+                # slices with cnt <= ddof are degenerate; numpy.ma masks
+                # them (data 0) — produce 0, not nan/inf
+                v = where(cnt > ddof, v * (cnt / (cnt - float(ddof))), 0.0)
             return v.sqrt() if fname == "std" else v
         return ndarray(
             Node(

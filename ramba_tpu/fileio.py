@@ -362,7 +362,16 @@ def _load_rtd(path: str, key=None) -> ndarray:
             )
         return out
 
-    return _sharded_from_reader(shape, dtype, read_slice)
+    try:
+        return _sharded_from_reader(shape, dtype, read_slice)
+    finally:
+        # the chunks were copied to device; holding the mmaps until GC can
+        # exhaust file descriptors in a long resume loop (advisor r3)
+        for m in mmaps.values():
+            mm = getattr(m, "_mmap", None)
+            if mm is not None:
+                mm.close()
+        mmaps.clear()
 
 
 register_loader(["rtd"], _load_rtd)

@@ -24,23 +24,72 @@ from ramba_tpu.core.ndarray import ndarray, as_exprable
 from ramba_tpu.ops.creation import asarray
 
 
+def _dist_segment_multi(pairs, labels, num_groups, mesh):
+    """Distributed segment reductions: per-shard LOCAL scatters under ONE
+    shard_map traversal, then an explicit cross-shard combine of the
+    (num_groups, rest) partials — the reference's per-worker partials +
+    tree reduce (ramba.py:2296-2331) in XLA-collective form.
+
+    ``pairs`` is a list of (op, data); all scatters share the single pass
+    so mean/var read the operand from HBM once, not 2-3 times.
+
+    r3 context: GSPMD miscompiles scatter-adds whose segment axis is
+    sharded (wrong partial sums; reconfirmed r4 through the groupby test
+    suite even with single-axis sharding).  The r3 workaround replicated
+    the whole operand (advisor r4: OOM risk).  Here every scatter runs on
+    a LOCAL unsharded block — the miscompiling pattern never reaches
+    GSPMD — and the operand stays fully distributed."""
+    from jax.sharding import PartitionSpec as _P
+
+    axes = tuple(mesh.axis_names)
+    k = int(np.prod([mesh.shape[a] for a in axes]))
+    if k == 1:
+        return [
+            getattr(jax.ops, f"segment_{op}")(d, labels, num_segments=num_groups)
+            for op, d in pairs
+        ]
+    n = pairs[0][1].shape[0]
+    pad = (-n) % k
+    ds = [d for _, d in pairs]
+    if pad:
+        ds = [
+            jnp.concatenate([d, jnp.zeros((pad,) + d.shape[1:], d.dtype)], 0)
+            for d in ds
+        ]
+        # padded rows land in a throwaway segment (num_groups)
+        labels = jnp.concatenate(
+            [labels, jnp.full((pad,), num_groups, labels.dtype)], 0
+        )
+
+    def local(lb, *blocks):
+        return tuple(
+            getattr(jax.ops, f"segment_{op}")(
+                b, lb, num_segments=num_groups + 1
+            )[None]
+            for (op, _), b in zip(pairs, blocks)
+        )
+
+    partials = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(_P(axes),) * (1 + len(ds)),
+        out_specs=(_P(axes),) * len(ds),
+        check_vma=False,
+    )(labels, *ds)  # each: (k, num_groups+1, rest...)
+    comb = {"sum": jnp.sum, "prod": jnp.prod,
+            "min": jnp.min, "max": jnp.max}
+    return [
+        comb[op](p, axis=0)[:num_groups]
+        for (op, _), p in zip(pairs, partials)
+    ]
+
+
 @defop("segment_reduce")
 def _op_segment_reduce(static, x, labels):
     kind, num_groups, dim = static
     x = jnp.moveaxis(x, dim, 0)
-    # GSPMD miscompiles scatter-adds whose segment axis is sharded on a
-    # multi-axis mesh (verified: segment_sum over a P('d1','d0')-sharded
-    # operand returns wrong partial sums).  Pin the segment axis unsharded
-    # — the scatter needs those rows gathered anyway — and leave the other
-    # dims to the partitioner.
-    from jax.sharding import NamedSharding, PartitionSpec as _P
-
     from ramba_tpu.parallel import mesh as _mesh
 
     mesh = _mesh.get_mesh()
-    if mesh.devices.size > 1:
-        spec = _P(None, *([_P.UNCONSTRAINED] * (x.ndim - 1)))
-        x = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
     if kind in ("nansum", "nanmean", "nanvar", "nanstd"):
         valid = ~jnp.isnan(x)
         data = jnp.where(valid, x, 0)
@@ -48,37 +97,30 @@ def _op_segment_reduce(static, x, labels):
         valid = None
         data = x
 
-    def seg(op, d):
-        return getattr(jax.ops, f"segment_{op}")(d, labels, num_segments=num_groups)
+    def seg_multi(pairs):
+        return _dist_segment_multi(pairs, labels, num_groups, mesh)
 
-    if kind in ("sum", "nansum"):
-        out = seg("sum", data)
-    elif kind == "prod":
-        out = seg("prod", data)
-    elif kind == "min":
-        out = seg("min", data)
-    elif kind == "max":
-        out = seg("max", data)
+    def cnt_src():
+        return (jnp.ones(x.shape, x.dtype) if valid is None
+                else valid.astype(x.dtype))
+
+    if kind in ("sum", "nansum", "prod", "min", "max"):
+        op = "sum" if kind == "nansum" else kind
+        (out,) = seg_multi([(op, data)])
     elif kind == "count":
         ones = jnp.ones(x.shape, jnp.int64 if jnp.zeros(0).dtype == jnp.float64
                         else jnp.int32)
         if valid is not None:
             ones = jnp.where(valid, ones, 0)
-        out = seg("sum", ones)
+        (out,) = seg_multi([("sum", ones)])
     elif kind in ("mean", "nanmean"):
-        s = seg("sum", data)
-        if valid is None:
-            cnt = seg("sum", jnp.ones(x.shape, x.dtype))
-        else:
-            cnt = seg("sum", valid.astype(x.dtype))
+        s, cnt = seg_multi([("sum", data), ("sum", cnt_src())])
         out = s / cnt
     elif kind in ("var", "std", "nanvar", "nanstd"):
-        if valid is None:
-            cnt = seg("sum", jnp.ones(x.shape, x.dtype))
-        else:
-            cnt = seg("sum", valid.astype(x.dtype))
-        s1 = seg("sum", data)
-        s2 = seg("sum", data * data)
+        # one traversal: count, sum, sumsq partials share the shard_map
+        cnt, s1, s2 = seg_multi(
+            [("sum", cnt_src()), ("sum", data), ("sum", data * data)]
+        )
         mean = s1 / cnt
         v = s2 / cnt - mean * mean
         out = jnp.sqrt(v) if kind in ("std", "nanstd") else v

@@ -220,10 +220,12 @@ def histogram(a, bins=10, range=None, weights=None, density=None):
 def modf(x):
     """numpy.modf: (fractional, integral) parts, both with x's sign."""
     x = asarray(x)
-    from ramba_tpu.ops.elementwise import trunc
+    from ramba_tpu.ops.elementwise import copysign, isinf, trunc, where
 
     ip = trunc(x)
-    return x - ip, ip
+    # x - trunc(x) would be inf - inf = nan at ±inf; numpy returns ±0.0
+    frac = where(isinf(x), copysign(0.0, x), x - ip)
+    return frac, ip
 
 
 def divmod(a, b):  # noqa: A001 - numpy name
