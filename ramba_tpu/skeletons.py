@@ -674,12 +674,29 @@ def _probe_associative(local_func, final_func) -> bool:
     * final_func(c, t) must equal combine(c, t) (the cross-block carry
       application must be the same op).
 
+    Advisor r3: positive-only samples let clamped accumulators (e.g.
+    ``max(0, x+c)``) pass while being non-associative on mixed-sign data.
+    The sample set now spans mixed signs, zero, integers, and large/small
+    magnitudes.  Residual risk remains for kernels associative on all
+    probed triples but not globally (probing can never be a proof) —
+    pass ``associative=False`` to force the always-correct sequential
+    carry chain, or ``associative=True`` to skip the probe.
+
     Any exception (e.g. a kernel that only accepts arrays) or mismatch
     falls back to the sequential path — detection can only upgrade.
     """
     try:
         rng = np.random.RandomState(7)
-        trips = rng.uniform(0.25, 2.0, size=(8, 3)).astype(np.float64)
+        trips = [
+            (5.0, -7.0, 3.0),            # mixed sign (catches clamps)
+            (-1.0, 2.0, -3.0),
+            (0.0, 1.0, -1.0),            # zeros
+            (0.0, 0.0, 0.0),
+            (1e8, -3.7, 1e-4),           # large/small magnitude
+            (-1e8, 1e8, 1.0),
+            (7.0, -3.0, 2.0),            # integer-valued
+            (2.0, 2.0, 2.0),
+        ] + [tuple(t) for t in rng.uniform(-4.0, 4.0, size=(8, 3))]
 
         def comb(a, b):
             return float(local_func(np.float64(b), np.float64(a)))
@@ -698,8 +715,10 @@ def _probe_associative(local_func, final_func) -> bool:
 
 @defop("scumulative")
 def _op_scumulative(static, x):
-    local_func, final_func, associative = static
+    local_func, final_func, associative, axis = static
+    x = jnp.moveaxis(x, axis, 0)  # scan along the leading axis
     n = x.shape[0]
+    rest = x.shape[1:]
     mesh = _mesh.get_mesh()
     axes = tuple(mesh.axis_names)
     nsh = int(np.prod([mesh.shape[a] for a in axes]))
@@ -709,7 +728,7 @@ def _op_scumulative(static, x):
             # log-depth vectorized scan on the VPU — the TPU-native
             # replacement for the reference's per-element Numba loop
             return jax.lax.associative_scan(
-                lambda a, c: _call_kernel(local_func, c, a), b
+                lambda a, c: _call_kernel(local_func, c, a), b, axis=0
             )
 
         def step(carry, xi):
@@ -717,27 +736,29 @@ def _op_scumulative(static, x):
             return (y, jnp.asarray(True)), y
 
         (_, _), ys = jax.lax.scan(
-            step, (jnp.zeros((), x.dtype), jnp.asarray(False)), b
+            step, (jnp.zeros(b.shape[1:], x.dtype), jnp.asarray(False)), b
         )
         return ys
 
     if nsh == 1 or n < max(nsh * 2, common.dist_threshold):
-        return local_scan(x)
+        return jnp.moveaxis(local_scan(x), 0, axis)
 
     # Distributed: per-shard scan under shard_map, then a cross-shard carry
     # fix-up.  The reference chains carries worker-to-worker sequentially
     # over its comm queues (ramba.py:3378-3437); here each shard all-gathers
-    # the per-shard totals (nsh scalars — one tiny collective) and folds its
-    # own exclusive carry locally, so the only cross-shard dependency is one
-    # all-gather instead of an nsh-deep message chain.
+    # the per-shard totals (nsh rest-slices — one small collective) and
+    # folds its own exclusive carry locally, so the only cross-shard
+    # dependency is one all-gather instead of an nsh-deep message chain.
     pad = (-n) % nsh
-    xp = jnp.pad(x, (0, pad)) if pad else x
+    xp = (
+        jnp.pad(x, [(0, pad)] + [(0, 0)] * len(rest)) if pad else x
+    )
 
     def per_shard(b):
         ys = local_scan(b)
         t = ys[-1]
         idx = jax.lax.axis_index(axes)
-        ts = jax.lax.all_gather(t, axes, tiled=False)
+        ts = jax.lax.all_gather(t, axes, tiled=False)  # (nsh, *rest)
 
         def fold(c, args):
             j, tj = args
@@ -745,37 +766,68 @@ def _op_scumulative(static, x):
             return nc, c  # emit the carry BEFORE tj: exclusive prefix
 
         _, excl = jax.lax.scan(
-            fold, jnp.zeros((), ys.dtype), (jnp.arange(nsh), ts)
+            fold, jnp.zeros(rest, ys.dtype), (jnp.arange(nsh), ts)
         )
         carry = excl[idx]
         fixed = _call_kernel(final_func, carry, ys)
         return jnp.where(idx == 0, ys, fixed)
 
+    spec = P(axes, *([None] * len(rest)))
     out = jax.shard_map(
-        per_shard, mesh=mesh, in_specs=P(axes), out_specs=P(axes),
+        per_shard, mesh=mesh, in_specs=spec, out_specs=spec,
         check_vma=False,
     )(xp)
-    return out[:n] if pad else out
+    if pad:
+        out = out[:n]
+    return jnp.moveaxis(out, 0, axis)
 
 
-def scumulative(local_func, final_func, arr, associative=None):
+def scumulative(local_func, final_func, arr, axis=0, dtype=None, out=None,
+                *, associative=None):
     """Reference: ramba.scumulative (docs/index.md:219-243,
-    ramba.py:10057-10115,3378-3437).
+    ramba.py:10057-10063,3378-3437) — N-D with ``axis``, accumulation
+    ``dtype``, and ``out=`` like the reference signature.
 
     ``associative=True`` (or a successful host-side probe when None, the
-    default) lowers the per-shard scan to ``lax.associative_scan``;
-    ``associative=False`` forces the sequential ``lax.scan`` element chain.
-    Either way blocks scan in parallel per shard and the cross-shard carry
-    is fixed up with one totals all-gather inside the same program."""
+    default — see ``_probe_associative`` for its limits) lowers the
+    per-shard scan to ``lax.associative_scan``; ``associative=False``
+    forces the sequential ``lax.scan`` element chain.  Either way blocks
+    scan in parallel per shard and the cross-shard carry is fixed up with
+    one totals all-gather inside the same program.
+
+    Distributed contract (same as the reference, docs/index.md:219-243):
+    ``final_func(boundary, block)`` must rebase a block-local scan given
+    the previous block's final value.  Kernels that cannot be rebased
+    elementwise (e.g. clamped accumulators) are exact only on the
+    single-shard path — identical to the reference, whose
+    ``scumulative_final`` applies final_func per worker block."""
     arr = asarray(arr)
-    if arr.ndim != 1:
-        raise ValueError("scumulative requires a 1-D array")
+    axis = int(axis)
+    if not (-arr.ndim <= axis < arr.ndim):
+        raise ValueError(
+            f"axis {axis} out of range for {arr.ndim}-D array"
+        )
+    axis %= arr.ndim
+    if dtype is not None and np.dtype(dtype) != arr.dtype:
+        arr = arr.astype(dtype)
     if associative is None:
         associative = _probe_associative(local_func, final_func)
-    return ndarray(
-        Node("scumulative", (local_func, final_func, bool(associative)),
-             [arr.read_expr()])
+    res = ndarray(
+        Node(
+            "scumulative",
+            (local_func, final_func, bool(associative), axis),
+            [arr.read_expr()],
+        )
     )
+    if out is not None:
+        if tuple(out.shape) != tuple(arr.shape):
+            raise ValueError(
+                f"out shape {out.shape} != array shape {arr.shape}"
+            )
+        res = res if out.dtype == res.dtype else res.astype(out.dtype)
+        out.write_expr(res.read_expr())
+        return out
+    return res
 
 
 # ---------------------------------------------------------------------------
@@ -792,10 +844,11 @@ class LocalView:
     reference's per-shard ``subspace`` shardview row index_start,
     shardview_array.py:32-70)."""
 
-    def __init__(self, block, global_start=None):
+    def __init__(self, block, global_start=None, global_shape=None):
         self._block = block
         self._updated = None
         self._global_start = global_start
+        self._global_shape = global_shape
 
     def get_local(self):
         return self._block if self._updated is None else self._updated
@@ -812,12 +865,40 @@ class LocalView:
         return self._global_start
 
     @property
+    def global_shape(self):
+        """Global shape of the distributed array (static ints)."""
+        if self._global_shape is None:
+            raise ValueError("global_shape is only available inside spmd")
+        return self._global_shape
+
+    @property
+    def local_valid(self):
+        """Per-dim count of VALID rows in this block (traced int32).  For
+        uneven distributions the trailing block is zero-padded up to the
+        uniform SPMD block size; rows at index >= local_valid[d] are
+        padding and their writes are discarded (reference parity: exact
+        per-worker shapes, ramba.py:1169-1357, expressed the SPMD way)."""
+        if self._global_start is None or self._global_shape is None:
+            raise ValueError("local_valid is only available inside spmd")
+        return tuple(
+            jnp.clip(
+                jnp.asarray(g, jnp.int32) - s, 0, b
+            )
+            for g, s, b in zip(
+                self._global_shape, self._global_start, self._block.shape
+            )
+        )
+
+    @property
     def shape(self):
         return self.get_local().shape
 
     @property
     def dtype(self):
         return self.get_local().dtype
+
+
+_replicated_write_warned = False
 
 
 def worker_id():
@@ -835,14 +916,19 @@ def worker_id():
 def spmd(func, *args):
     """Reference: ramba.spmd (docs/index.md:247-266, ramba.py:10173-10180,
     3477-3491).  Runs ``func`` once per mesh device under shard_map; ndarray
-    args arrive as LocalView shards; ``set_local`` updates propagate back."""
+    args arrive as LocalView shards; ``set_local`` updates propagate back.
+
+    Reference parity for arbitrary distributions (ramba.py:1169-1357):
+    uneven shards are zero-padded to the uniform SPMD block internally and
+    unpadded on write-back (kernels can bound block-coupled computations
+    with ``LocalView.local_valid``); replicated (small) arrays arrive
+    whole on every device, like the reference's replicated bdarrays."""
     mesh = _mesh.get_mesh()
     axes = tuple(mesh.axis_names)
     arr_positions = [i for i, a in enumerate(args) if isinstance(a, ndarray)]
     arrays = [args[i] for i in arr_positions]
     vals = [a._value() for a in arrays]
     specs = []
-    n_shards = int(np.prod([mesh.shape[a] for a in axes]))
     for v in vals:
         # Respect the sharding the user (or the layout solver) already gave
         # the array — re-sharding to default_spec would hand the kernel
@@ -857,29 +943,23 @@ def spmd(func, *args):
             spec = existing.spec
         if spec is None:
             spec = _mesh.default_spec(v.shape, mesh)
-        if spec == P():
-            raise ValueError(
-                "spmd requires distributed arrays: an array of "
-                f"{int(np.prod(v.shape))} elements is replicated (below the "
-                f"RAMBA_DIST_THRESHOLD of {__import__('ramba_tpu').common.dist_threshold}), "
-                "so every worker would see the whole array"
-            )
-        # shard_map needs even divisibility along the sharded dims
-        for d, entry in enumerate(spec):
+        specs.append(spec)
+    # Zero-pad uneven dims up to shard_map's uniform block size; padding is
+    # sliced back off after the call, so pad-region writes are discarded.
+    orig_shapes = [tuple(v.shape) for v in vals]
+    padded = []
+    for v, spec in zip(vals, specs):
+        pads = [(0, 0)] * v.ndim
+        for d, entry in enumerate(tuple(spec)):
             if entry is None:
                 continue
             names = (entry,) if isinstance(entry, str) else tuple(entry)
             k = int(np.prod([mesh.shape[nm] for nm in names]))
-            if v.shape[d] % k != 0:
-                raise ValueError(
-                    f"spmd: array dim {d} of size {v.shape[d]} is not "
-                    f"divisible by the {k}-way mesh split; pad the array or "
-                    f"reshape so each worker gets an equal block"
-                )
-        specs.append(spec)
-    vals = [
-        jax.device_put(v, NamedSharding(mesh, s)) for v, s in zip(vals, specs)
-    ]
+            pads[d] = (0, (-v.shape[d]) % k)
+        if any(p[1] for p in pads):
+            v = jnp.pad(v, pads)
+        padded.append(jax.device_put(v, NamedSharding(mesh, spec)))
+    vals = padded
 
     def _starts(spec, block_shape):
         """Global offset of this device's block per dim, from mesh coords
@@ -899,19 +979,42 @@ def spmd(func, *args):
 
     def inner(*blocks):
         views = [
-            LocalView(b, _starts(s, b.shape)) for b, s in zip(blocks, specs)
+            LocalView(b, _starts(s, b.shape), gs)
+            for b, s, gs in zip(blocks, specs, orig_shapes)
         ]
         call_args = list(args)
         for p, v in zip(arr_positions, views):
             call_args[p] = v
         func(*call_args)
-        return tuple(v.get_local() for v in views)
+        outs = []
+        for v, s in zip(views, specs):
+            o = v.get_local()
+            replicated = all(e is None for e in tuple(s)) or tuple(s) == ()
+            if replicated and v._updated is not None:
+                # Reference semantics for replicated bdarrays: the driver
+                # reads worker 0's copy.  Make that deterministic (a bare
+                # out_specs=P() would keep an arbitrary device's copy) and
+                # say so — device-divergent writes are NOT merged.
+                global _replicated_write_warned
+                if not _replicated_write_warned:
+                    _replicated_write_warned = True
+                    warnings.warn(
+                        "spmd kernel wrote to a replicated (small) array; "
+                        "worker 0's copy wins (reference semantics) — "
+                        "device-divergent writes to replicated arrays are "
+                        "not merged"
+                    )
+                o = jax.lax.all_gather(o, axes, tiled=False)[0]
+            outs.append(o)
+        return tuple(outs)
 
     outs = jax.shard_map(
         inner, mesh=mesh, in_specs=tuple(specs), out_specs=tuple(specs),
         check_vma=False,
     )(*vals)
-    for a, new in zip(arrays, outs):
+    for a, new, gs in zip(arrays, outs, orig_shapes):
+        if tuple(new.shape) != gs:
+            new = new[tuple(slice(0, s) for s in gs)]
         a.write_expr(Const(new))
     return None
 

@@ -260,6 +260,66 @@ class TestScumulative:
         ).asarray()
         np.testing.assert_allclose(got, np.cumsum(v), rtol=1e-8)
 
+    def test_2d_both_axes(self):
+        # reference signature: scumulative(local, final, arr, axis, ...)
+        # (ramba.py:10057) — N-D with an axis argument
+        x = np.random.RandomState(4).randn(6, 10)
+        for ax in (0, 1, -1):
+            got = rt.scumulative(
+                lambda v, c: v + c, lambda c, b: b + c, rt.fromarray(x), ax
+            ).asarray()
+            np.testing.assert_allclose(got, np.cumsum(x, axis=ax), rtol=1e-12)
+
+    def test_2d_distributed_both_axes(self):
+        x = np.random.RandomState(5).randn(4096, 4)
+        got = rt.scumulative(
+            lambda v, c: v + c, lambda c, b: b + c, rt.fromarray(x), 0
+        ).asarray()
+        np.testing.assert_allclose(got, np.cumsum(x, axis=0), rtol=1e-9)
+        xt = np.ascontiguousarray(x.T)
+        got = rt.scumulative(
+            lambda v, c: v + c, lambda c, b: b + c, rt.fromarray(xt), 1
+        ).asarray()
+        np.testing.assert_allclose(got, np.cumsum(xt, axis=1), rtol=1e-9)
+
+    def test_dtype_and_out(self):
+        xi = np.random.RandomState(6).randint(0, 5, size=20)
+        g = rt.scumulative(
+            lambda v, c: v + c, lambda c, b: b + c, rt.fromarray(xi), 0,
+            np.float64,
+        )
+        assert g.dtype == np.float64
+        np.testing.assert_allclose(g.asarray(), np.cumsum(xi).astype(float))
+        out = rt.zeros(20)
+        ret = rt.scumulative(
+            lambda v, c: v + c, lambda c, b: b + c,
+            rt.fromarray(xi.astype(float)), 0, out=out,
+        )
+        assert ret is out
+        np.testing.assert_allclose(out.asarray(), np.cumsum(xi).astype(float))
+
+    def test_clamp_probe_rejected_and_sequential_exact(self):
+        # advisor r3 (medium): max(0, x+c) passed the positive-only probe
+        # yet is non-associative on mixed signs; the probe must reject it
+        # and the (single-shard) sequential path must match the loop
+        from ramba_tpu.skeletons import _probe_associative
+
+        lf = lambda v, c: np.maximum(0.0, v + c)  # noqa: E731
+        assert not _probe_associative(lf, lambda c, b: np.maximum(0.0, b + c))
+
+        v = np.random.RandomState(7).randn(64)  # below dist threshold
+        want = [v[0]]
+        for xi in v[1:]:
+            want.append(max(0.0, xi + want[-1]))
+        got = rt.scumulative(lf, lambda c, b: b, rt.fromarray(v)).asarray()
+        np.testing.assert_allclose(got, np.array(want), rtol=1e-12)
+
+    def test_axis_out_of_range(self):
+        with pytest.raises(ValueError, match="axis"):
+            rt.scumulative(
+                lambda v, c: v + c, lambda c, b: b + c, rt.ones(8), 1
+            )
+
 
 class TestSpmd:
     def test_spmd_set_local(self):
@@ -315,6 +375,84 @@ class TestSpmd:
         rt.spmd(worker, a)
         assert shapes[0] == (16, 8), shapes  # full rows, 1/n_all of cols
         np.testing.assert_allclose(a.asarray(), np.ones((16, 8 * n_all)))
+
+    def test_spmd_uneven_shards(self):
+        # r3 verdict missing #3: 1001 elements on the 8-way mesh must work
+        # (pad-and-unpad internally), reference: ramba.py:3477-3491
+        a = rt.fromarray(np.zeros(1001))
+        rt.sync()
+
+        def worker(lv):
+            lv.set_local(lv.get_local() + rt.worker_id().astype(lv.dtype) + 1.0)
+
+        rt.spmd(worker, a)
+        exp = np.repeat(np.arange(8) + 1.0, 126)[:1001]
+        np.testing.assert_array_equal(a.asarray(), exp)
+
+    def test_spmd_replicated_array(self):
+        # small (below dist threshold) arrays arrive whole per device
+        b = rt.fromarray(np.arange(10.0))
+        rt.sync()
+
+        def w(lv):
+            assert lv.shape == (10,)
+            lv.set_local(lv.get_local() * 2.0)
+
+        rt.spmd(w, b)
+        np.testing.assert_array_equal(b.asarray(), np.arange(10.0) * 2)
+
+    def test_spmd_replicated_divergent_write_deterministic(self):
+        # review r4: divergent per-device writes to a replicated array must
+        # resolve deterministically (worker 0 wins, reference semantics)
+        # and warn — never keep an arbitrary device's copy silently
+        import warnings as _w
+
+        from ramba_tpu import skeletons
+
+        skeletons._replicated_write_warned = False
+        a = rt.fromarray(np.zeros(10))
+        rt.sync()
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            rt.spmd(
+                lambda lv: lv.set_local(
+                    lv.get_local() + rt.worker_id().astype(lv.dtype)
+                ),
+                a,
+            )
+        np.testing.assert_array_equal(a.asarray(), np.zeros(10))
+        assert any("worker 0" in str(w.message) for w in rec)
+
+    def test_spmd_local_valid_bound(self):
+        # kernels can bound block-coupled computations by the valid extent
+        import jax.numpy as jnp
+
+        c = rt.fromarray(np.ones(1001))
+        rt.sync()
+
+        def w(lv):
+            n_valid = lv.local_valid[0]
+            assert lv.global_shape == (1001,)
+            block = lv.get_local()
+            idx = jnp.arange(block.shape[0])
+            lv.set_local(
+                jnp.where(idx < n_valid, block + n_valid.astype(block.dtype),
+                          block)
+            )
+
+        rt.spmd(w, c)
+        counts = np.repeat([126] * 7 + [1001 - 126 * 7], 126)[:1001]
+        np.testing.assert_array_equal(c.asarray(), 1.0 + counts)
+
+    def test_spmd_2d_uneven(self):
+        d = rt.fromarray(np.zeros((13, 9)))
+        rt.sync()
+
+        def w(lv):
+            lv.set_local(lv.get_local() + 1.0)
+
+        rt.spmd(w, d)
+        np.testing.assert_array_equal(d.asarray(), np.ones((13, 9)))
 
     def test_barrier(self):
         rt.barrier()
@@ -489,13 +627,20 @@ class TestReviewRegressions2:
         e[1:-1] = v[:-2] + v[2:] + 5.0
         np.testing.assert_allclose(out, e)
 
-    def test_spmd_replicated_raises(self):
-        with pytest.raises(ValueError, match="replicated"):
-            rt.spmd(lambda l: None, rt.zeros(50))
+    def test_spmd_replicated_runs_per_device(self):
+        # r4: replicated arrays run per-device (reference parity) instead
+        # of raising; a no-op kernel leaves the array unchanged
+        a = rt.fromarray(np.arange(50.0))
+        rt.sync()
+        rt.spmd(lambda l: None, a)
+        np.testing.assert_array_equal(a.asarray(), np.arange(50.0))
 
-    def test_spmd_indivisible_raises(self):
-        with pytest.raises(ValueError, match="divisible"):
-            rt.spmd(lambda l: None, rt.zeros(801))
+    def test_spmd_indivisible_pads_and_unpads(self):
+        # r4: 801 on the 8-way mesh pads internally; writes stick, shape kept
+        a = rt.fromarray(np.zeros(801))
+        rt.sync()
+        rt.spmd(lambda l: l.set_local(l.get_local() + 1.0), a)
+        np.testing.assert_array_equal(a.asarray(), np.ones(801))
 
     def test_groupby_scalar_binop(self):
         v = np.arange(12, dtype=float).reshape(6, 2)
