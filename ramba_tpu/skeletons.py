@@ -890,6 +890,21 @@ class LocalView:
         )
 
     @property
+    def valid_mask(self):
+        """Boolean mask over this block, True where the element is real
+        data and False in the zero-padding of an uneven distribution.
+        Use to bound block-coupled computations, e.g.
+        ``masked = jnp.where(lv.valid_mask, lv.get_local(), identity)``."""
+        valid = self.local_valid
+        mask = jnp.ones(self._block.shape, bool)
+        for d, nv in enumerate(valid):
+            idx = jnp.arange(self._block.shape[d])
+            shape = [1] * self._block.ndim
+            shape[d] = -1
+            mask = mask & (idx.reshape(shape) < nv)
+        return mask
+
+    @property
     def shape(self):
         return self.get_local().shape
 
@@ -899,6 +914,7 @@ class LocalView:
 
 
 _replicated_write_warned = False
+_uneven_pad_warned = False
 
 
 def worker_id():
@@ -920,9 +936,13 @@ def spmd(func, *args):
 
     Reference parity for arbitrary distributions (ramba.py:1169-1357):
     uneven shards are zero-padded to the uniform SPMD block internally and
-    unpadded on write-back (kernels can bound block-coupled computations
-    with ``LocalView.local_valid``); replicated (small) arrays arrive
-    whole on every device, like the reference's replicated bdarrays."""
+    unpadded on write-back (a one-time warning fires; kernels must bound
+    block-coupled computations with ``LocalView.local_valid`` /
+    ``LocalView.valid_mask`` — zero-padding is the correct identity for
+    add-style contractions but skews min/mean/max over the block);
+    replicated (small) arrays arrive whole on every device, like the
+    reference's replicated bdarrays.  Writes to copies replicated along
+    any mesh axis resolve deterministically to the coordinate-0 copy."""
     mesh = _mesh.get_mesh()
     axes = tuple(mesh.axis_names)
     arr_positions = [i for i, a in enumerate(args) if isinstance(a, ndarray)]
@@ -957,6 +977,20 @@ def spmd(func, *args):
             k = int(np.prod([mesh.shape[nm] for nm in names]))
             pads[d] = (0, (-v.shape[d]) % k)
         if any(p[1] for p in pads):
+            # Loud signal (review round 4): zero-padding is the correct
+            # identity for add-style contractions but silently skews
+            # min/mean/max-style block computations — point kernels at the
+            # masking tools instead of corrupting quietly.
+            global _uneven_pad_warned
+            if not _uneven_pad_warned:
+                _uneven_pad_warned = True
+                warnings.warn(
+                    f"spmd: array of shape {tuple(v.shape)} does not divide "
+                    f"evenly over the mesh; trailing blocks are zero-padded "
+                    f"to the uniform SPMD block. Block-coupled computations "
+                    f"(min/mean/matmul over the block) must mask the padding "
+                    f"via LocalView.local_valid or LocalView.valid_mask."
+                )
             v = jnp.pad(v, pads)
         padded.append(jax.device_put(v, NamedSharding(mesh, spec)))
     vals = padded
@@ -989,22 +1023,32 @@ def spmd(func, *args):
         outs = []
         for v, s in zip(views, specs):
             o = v.get_local()
-            replicated = all(e is None for e in tuple(s)) or tuple(s) == ()
-            if replicated and v._updated is not None:
-                # Reference semantics for replicated bdarrays: the driver
-                # reads worker 0's copy.  Make that deterministic (a bare
-                # out_specs=P() would keep an arbitrary device's copy) and
-                # say so — device-divergent writes are NOT merged.
+            # Mesh axes the spec does not mention hold replicated copies of
+            # this array — fully replicated (spec all-None) or partially
+            # (e.g. P('d0', None) on a 2-axis mesh replicates along d1).
+            # Divergent writes across those copies would otherwise be
+            # dropped arbitrarily by out_specs; make the coordinate-0 copy
+            # win deterministically and say so (reference semantics: the
+            # driver reads worker 0's copy of replicated bdarrays).
+            mentioned = set()
+            for entry in tuple(s):
+                if entry is not None:
+                    mentioned.update(
+                        (entry,) if isinstance(entry, str) else tuple(entry)
+                    )
+            unused = tuple(nm for nm in axes if nm not in mentioned)
+            if unused and v._updated is not None:
                 global _replicated_write_warned
                 if not _replicated_write_warned:
                     _replicated_write_warned = True
                     warnings.warn(
-                        "spmd kernel wrote to a replicated (small) array; "
-                        "worker 0's copy wins (reference semantics) — "
-                        "device-divergent writes to replicated arrays are "
-                        "not merged"
+                        f"spmd kernel wrote to an array replicated along "
+                        f"mesh ax{'is' if len(unused) == 1 else 'es'} "
+                        f"{unused}; the coordinate-0 copy wins (reference "
+                        f"semantics) — device-divergent writes to "
+                        f"replicated copies are not merged"
                     )
-                o = jax.lax.all_gather(o, axes, tiled=False)[0]
+                o = jax.lax.all_gather(o, unused, tiled=False)[0]
             outs.append(o)
         return tuple(outs)
 

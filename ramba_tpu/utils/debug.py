@@ -93,6 +93,30 @@ def report_pending(file=None) -> int:
     return len(arrs)
 
 
+def drain_effect_errors() -> Exception | None:
+    """Consume any poisoned jax runtime-effect tokens, returning the first
+    error (or None).
+
+    A kernel host-fallback (``pure_callback``) that raises — e.g. a
+    ``KernelTraceError`` from a dtype-probe miss — leaves its error attached
+    to jax's runtime token set; jax re-raises it at the *next* effects sync,
+    which may be an unrelated computation or interpreter exit ("Exception
+    ignored in atexit").  Call this after catching such an error to reset
+    the token state.  jax's own ``block_until_ready`` skips its ``clear()``
+    when a token raises, hence the explicit clear here.
+    """
+    from jax._src import dispatch as _dispatch
+
+    err: Exception | None = None
+    try:
+        _dispatch.runtime_tokens.block_until_ready()
+    except Exception as e:  # noqa: BLE001 - error is the return value
+        err = e
+    finally:
+        _dispatch.runtime_tokens.clear()
+    return err
+
+
 def _dump_history() -> None:
     """Write flush statistics at exit (reference: dag-count history files,
     ramba.py:5120-5128)."""

@@ -94,8 +94,13 @@ def test_smap_index_branching_broadcast_operands():
 def test_smap_branch_probe_miss_raises_not_truncates():
     # dtype only discoverable on values the probe never sees: loud error
     # beats silent truncation
+    from ramba_tpu.utils.debug import drain_effect_errors
+
     with pytest.raises(Exception, match="probe inferred"):
         np.asarray(rt.smap(lambda x: x / 2 if abs(x) > 10 else 0, [1.0, 100.0]))
+    # the failing pure_callback leaves a poisoned runtime token; drain it here
+    # so the error doesn't resurface as "Exception ignored in atexit"
+    drain_effect_errors()
 
 
 def test_sreduce_branching_raises_loudly():

@@ -421,7 +421,75 @@ class TestSpmd:
                 a,
             )
         np.testing.assert_array_equal(a.asarray(), np.zeros(10))
-        assert any("worker 0" in str(w.message) for w in rec)
+        assert any("coordinate-0" in str(w.message) for w in rec)
+
+    def test_spmd_partial_sharding_divergent_write_deterministic(self):
+        # review r4 finding 1: an array sharded along a SUBSET of mesh axes
+        # is replicated along the rest; divergent writes across those
+        # copies must also resolve to the coordinate-0 copy, with the same
+        # warning — not silently keep an arbitrary device's copy
+        import warnings as _w
+
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ramba_tpu import skeletons
+        from ramba_tpu.core.expr import Const
+        from ramba_tpu.parallel import mesh as _mesh
+
+        mesh = _mesh.get_mesh()
+        axes = tuple(mesh.axis_names)
+        if len(axes) < 2:
+            pytest.skip("needs a multi-axis mesh")
+        d0 = mesh.shape[axes[0]]
+        rest = int(np.prod([mesh.shape[a] for a in axes[1:]]))
+        n = d0 * 16
+        v = jax.device_put(
+            np.zeros(n), NamedSharding(mesh, P(axes[0]))
+        )
+        a = rt.fromarray(np.zeros(n))
+        a.write_expr(Const(v))
+        rt.sync()
+
+        skeletons._replicated_write_warned = False
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            rt.spmd(
+                lambda lv: lv.set_local(
+                    lv.get_local() + rt.worker_id().astype(lv.dtype)
+                ),
+                a,
+            )
+        # copy kept for block i is from mesh coordinate (i, 0, ..., 0),
+        # whose worker_id is i * prod(other axis sizes)
+        exp = np.repeat(np.arange(d0) * rest, 16).astype(float)
+        np.testing.assert_array_equal(a.asarray(), exp)
+        assert any("coordinate-0" in str(w.message) for w in rec)
+
+    def test_spmd_uneven_pad_warns_and_valid_mask(self):
+        # review r4 finding 2: padding must announce itself (block-coupled
+        # computations like min silently skew otherwise), and valid_mask
+        # must make bounding them easy
+        import warnings as _w
+
+        import jax.numpy as jnp
+
+        from ramba_tpu import skeletons
+
+        skeletons._uneven_pad_warned = False
+        c = rt.fromarray(np.full(1001, 5.0))
+        rt.sync()
+
+        def w(lv):
+            blk = lv.get_local()
+            masked_min = jnp.min(jnp.where(lv.valid_mask, blk, jnp.inf))
+            lv.set_local(blk - masked_min)
+
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            rt.spmd(w, c)
+        assert any("zero-padded" in str(w_.message) for w_ in rec)
+        np.testing.assert_array_equal(c.asarray(), np.zeros(1001))
 
     def test_spmd_local_valid_bound(self):
         # kernels can bound block-coupled computations by the valid extent
