@@ -266,6 +266,10 @@ def _host_smap(func, slots, with_index, ndim, arrs):
         np.result_type(*dtypes) if dtypes
         else np.result_type(*[np.dtype(a.dtype) for a in arrs])
     )
+    # x32 regime (TPU): pure_callback rejects 64-bit result dtypes outright;
+    # fold the probed dtype through jax's truncation lattice (identity when
+    # x64 is on)
+    out_dtype = np.dtype(jax.dtypes.canonicalize_dtype(out_dtype))
 
     def host(*arrays):
         arrays = [np.asarray(a) for a in arrays]

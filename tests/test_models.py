@@ -3,6 +3,7 @@
 import numpy as np
 
 import ramba_tpu as rt
+from tests.helpers import default_rtol, x64_enabled
 from ramba_tpu.core import fuser
 from ramba_tpu.models.jacobi import jacobi2d, residual
 from ramba_tpu.models.kmeans import kmeans
@@ -11,7 +12,7 @@ from ramba_tpu.models.pi import integrate_pi
 
 class TestPi:
     def test_value(self):
-        assert abs(integrate_pi(1_000_000) - np.pi) < 1e-9
+        assert abs(integrate_pi(1_000_000) - np.pi) < (1e-9 if x64_enabled() else 1e-6)
 
     def test_fully_fused(self):
         rt.sync()
@@ -29,7 +30,7 @@ class TestJacobi:
         assert residual(u, f) < 0.05
         # symmetric problem -> symmetric iterate
         ua = u.asarray()
-        np.testing.assert_allclose(ua, ua.T, atol=1e-6)
+        np.testing.assert_allclose(ua, ua.T, atol=1e-6 if x64_enabled() else 1e-5)
 
     def test_block_flushing_reuses_compiles(self):
         from ramba_tpu.core import fuser
@@ -54,7 +55,7 @@ class TestJacobi:
                 + f[1:-1, 1:-1]
             )
             u = nxt
-        np.testing.assert_allclose(got, u, rtol=1e-6, atol=1e-8)
+        np.testing.assert_allclose(got, u, rtol=default_rtol(1e-6), atol=1e-8 if x64_enabled() else 1e-6)
 
 
 class TestKMeans:

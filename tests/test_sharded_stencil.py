@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 import ramba_tpu as rt
+from tests.helpers import default_rtol
 from ramba_tpu.ops import stencil_pallas, stencil_sharded
 from ramba_tpu.parallel import mesh as _mesh
 
@@ -87,7 +88,7 @@ class TestShardedStencil:
         out = rt.sstencil(shifted, rt.fromarray(x)).asarray()
         e = np.zeros_like(x)
         e[3:, :-2] = x[:-3, :-2] + x[3:, 2:]
-        np.testing.assert_allclose(out, e, rtol=1e-6)
+        np.testing.assert_allclose(out, e, rtol=default_rtol(1e-6))
 
     def test_corner_offsets(self, sharded_only):
         # diagonal reads require corner halos (col-then-row exchange)
@@ -99,7 +100,7 @@ class TestShardedStencil:
         out = rt.sstencil(diag, rt.fromarray(x)).asarray()
         e = np.zeros_like(x)
         e[1:-1, 1:-1] = x[:-2, :-2] + x[2:, 2:]
-        np.testing.assert_allclose(out, e, rtol=1e-6)
+        np.testing.assert_allclose(out, e, rtol=default_rtol(1e-6))
 
     def test_two_input_arrays(self, sharded_only):
         @rt.stencil
@@ -111,7 +112,7 @@ class TestShardedStencil:
         out = rt.sstencil(mix, rt.fromarray(x), rt.fromarray(y)).asarray()
         e = np.zeros_like(x)
         e[1:-1, :] = x[1:-1, :] + 0.5 * (y[:-2, :] + y[2:, :])
-        np.testing.assert_allclose(out, e, rtol=1e-6)
+        np.testing.assert_allclose(out, e, rtol=default_rtol(1e-6))
 
     def test_literal_arg(self, sharded_only):
         @rt.stencil
@@ -122,7 +123,7 @@ class TestShardedStencil:
         out = rt.sstencil(scaled, rt.fromarray(x), 0.5).asarray()
         e = np.zeros_like(x)
         e[:, 1:-1] = 0.5 * (x[:, :-2] + x[:, 2:])
-        np.testing.assert_allclose(out, e, rtol=1e-6)
+        np.testing.assert_allclose(out, e, rtol=default_rtol(1e-6))
 
     def test_hlo_uses_ppermute_not_allgather(self):
         """The halo exchange must be nearest-neighbor collective-permutes;
@@ -198,7 +199,7 @@ class TestShardedStencilND:
         got = rt.sstencil(avg3, rt.fromarray(v)).asarray()
         e = np.zeros_like(v)
         e[1:-1] = (v[:-2] + v[1:-1] + v[2:]) / 3.0
-        np.testing.assert_allclose(got, e, rtol=1e-9)
+        np.testing.assert_allclose(got, e, rtol=default_rtol(1e-9))
 
     def test_1d_dispatches_sharded(self, monkeypatch):
         calls = {"n": 0}
@@ -219,7 +220,7 @@ class TestShardedStencilND:
         assert calls["n"] >= 1
         e = np.zeros_like(v)
         e[1:-1] = v[2:] - v[:-2]
-        np.testing.assert_allclose(got, e, rtol=1e-9)
+        np.testing.assert_allclose(got, e, rtol=default_rtol(1e-9))
 
     def test_3d_stencil(self):
         @rt.stencil
@@ -238,7 +239,7 @@ class TestShardedStencilND:
             + v[1:-1, :-2, 1:-1] + v[1:-1, 2:, 1:-1]
             + v[1:-1, 1:-1, :-2] + v[1:-1, 1:-1, 2:]
         ) / 6.0
-        np.testing.assert_allclose(got, e, rtol=1e-9)
+        np.testing.assert_allclose(got, e, rtol=default_rtol(1e-9))
 
     def test_3d_odd_shapes(self):
         @rt.stencil
@@ -251,4 +252,4 @@ class TestShardedStencilND:
         # k in [0,n2-1)
         e = np.zeros_like(v)
         e[1:-1, 1:, :-1] = v[:-2, 1:, 1:] + v[2:, :-1, :-1]
-        np.testing.assert_allclose(got, e, rtol=1e-9)
+        np.testing.assert_allclose(got, e, rtol=default_rtol(1e-9))

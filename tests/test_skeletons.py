@@ -9,6 +9,7 @@ import numpy as np
 import pytest
 
 import ramba_tpu as rt
+from tests.helpers import default_atol, default_rtol
 
 
 class TestSmap:
@@ -220,8 +221,8 @@ class TestScumulative:
             lambda x, c: x + c, lambda c, b: b + c,
             rt.fromarray(v), associative=False,
         ).asarray()
-        np.testing.assert_allclose(fast, np.cumsum(v), rtol=1e-9)
-        np.testing.assert_allclose(slow, np.cumsum(v), rtol=1e-9)
+        np.testing.assert_allclose(fast, np.cumsum(v), rtol=default_rtol(1e-9), atol=default_atol())
+        np.testing.assert_allclose(slow, np.cumsum(v), rtol=default_rtol(1e-9), atol=default_atol())
 
     def test_nonassociative_ema(self):
         # y_i = 0.5*x_i + 0.5*y_{i-1}: carries must chain sequentially;
@@ -242,7 +243,7 @@ class TestScumulative:
             lambda c, b: b,  # unused on the single-shard path
             rt.fromarray(v),
         ).asarray()
-        np.testing.assert_allclose(got, np.array(want), rtol=1e-9)
+        np.testing.assert_allclose(got, np.array(want), rtol=default_rtol(1e-9), atol=default_atol())
 
     def test_large_distributed_cumsum(self):
         n = 10_000
@@ -250,7 +251,7 @@ class TestScumulative:
         got = rt.scumulative(
             lambda x, c: x + c, lambda c, b: b + c, rt.fromarray(v)
         ).asarray()
-        np.testing.assert_allclose(got, np.cumsum(v), rtol=1e-7)
+        np.testing.assert_allclose(got, np.cumsum(v), rtol=default_rtol(1e-7), atol=default_atol())
 
     def test_odd_length_padding(self):
         n = 1003  # not divisible by the 8-shard mesh
@@ -258,7 +259,7 @@ class TestScumulative:
         got = rt.scumulative(
             lambda x, c: x + c, lambda c, b: b + c, rt.fromarray(v)
         ).asarray()
-        np.testing.assert_allclose(got, np.cumsum(v), rtol=1e-8)
+        np.testing.assert_allclose(got, np.cumsum(v), rtol=default_rtol(1e-8), atol=default_atol())
 
     def test_2d_both_axes(self):
         # reference signature: scumulative(local, final, arr, axis, ...)
@@ -268,19 +269,19 @@ class TestScumulative:
             got = rt.scumulative(
                 lambda v, c: v + c, lambda c, b: b + c, rt.fromarray(x), ax
             ).asarray()
-            np.testing.assert_allclose(got, np.cumsum(x, axis=ax), rtol=1e-12)
+            np.testing.assert_allclose(got, np.cumsum(x, axis=ax), rtol=default_rtol(1e-12), atol=default_atol())
 
     def test_2d_distributed_both_axes(self):
         x = np.random.RandomState(5).randn(4096, 4)
         got = rt.scumulative(
             lambda v, c: v + c, lambda c, b: b + c, rt.fromarray(x), 0
         ).asarray()
-        np.testing.assert_allclose(got, np.cumsum(x, axis=0), rtol=1e-9)
+        np.testing.assert_allclose(got, np.cumsum(x, axis=0), rtol=default_rtol(1e-9), atol=default_atol())
         xt = np.ascontiguousarray(x.T)
         got = rt.scumulative(
             lambda v, c: v + c, lambda c, b: b + c, rt.fromarray(xt), 1
         ).asarray()
-        np.testing.assert_allclose(got, np.cumsum(xt, axis=1), rtol=1e-9)
+        np.testing.assert_allclose(got, np.cumsum(xt, axis=1), rtol=default_rtol(1e-9), atol=default_atol())
 
     def test_dtype_and_out(self):
         xi = np.random.RandomState(6).randint(0, 5, size=20)
@@ -288,7 +289,9 @@ class TestScumulative:
             lambda v, c: v + c, lambda c, b: b + c, rt.fromarray(xi), 0,
             np.float64,
         )
-        assert g.dtype == np.float64
+        from tests.helpers import map_dtype
+
+        assert g.dtype == map_dtype(np.float64)
         np.testing.assert_allclose(g.asarray(), np.cumsum(xi).astype(float))
         out = rt.zeros(20)
         ret = rt.scumulative(
@@ -312,7 +315,7 @@ class TestScumulative:
         for xi in v[1:]:
             want.append(max(0.0, xi + want[-1]))
         got = rt.scumulative(lf, lambda c, b: b, rt.fromarray(v)).asarray()
-        np.testing.assert_allclose(got, np.array(want), rtol=1e-12)
+        np.testing.assert_allclose(got, np.array(want), rtol=default_rtol(1e-12), atol=default_atol())
 
     def test_axis_out_of_range(self):
         with pytest.raises(ValueError, match="axis"):
@@ -545,7 +548,7 @@ class TestGroupby:
         expected = np.stack(
             [getattr(np, red)(v[labels == k], axis=0) for k in range(3)]
         )
-        np.testing.assert_allclose(got, expected, rtol=1e-10)
+        np.testing.assert_allclose(got, expected, rtol=default_rtol(1e-10), atol=default_atol())
 
     def test_count(self):
         v, labels = self._data()
@@ -562,7 +565,7 @@ class TestGroupby:
         expected = np.stack(
             [np.nanmean(v[labels == k], axis=0) for k in range(3)]
         )
-        np.testing.assert_allclose(got, expected, rtol=1e-10)
+        np.testing.assert_allclose(got, expected, rtol=default_rtol(1e-10), atol=default_atol())
 
     def test_anomaly_pattern(self):
         # the xarray climatology/anomaly idiom the reference's rewrite
@@ -575,7 +578,7 @@ class TestGroupby:
         expected = v - np.stack(
             [np.mean(v[labels == k], axis=0) for k in range(3)]
         )[labels]
-        np.testing.assert_allclose(anom, expected, rtol=1e-10)
+        np.testing.assert_allclose(anom, expected, rtol=default_rtol(1e-10), atol=default_atol())
 
     def test_groupby_axis1(self):
         v = np.arange(24, dtype=float).reshape(4, 6)
@@ -973,9 +976,10 @@ class TestRtdShardedFormat:
         import json
 
         p = str(tmp_path / "f.rtd")
-        rt.save(p, rt.fromarray(np.ones((64, 64))))
+        a = rt.fromarray(np.ones((64, 64)))
+        rt.save(p, a)
         with open(p + "/manifest.p7.json", "w") as f:
-            json.dump({"shape": [64, 64], "dtype": "float64", "nproc": 1,
-                       "shards": []}, f)
+            json.dump({"shape": [64, 64], "dtype": str(np.dtype(a.dtype)),
+                       "nproc": 1, "shards": []}, f)
         with pytest.raises(ValueError, match="manifest parts"):
             rt.load(p).asarray()

@@ -15,6 +15,7 @@ import pytest
 import pandas as pd
 
 import ramba_tpu as rt
+from tests.helpers import default_atol, default_rtol
 from ramba_tpu.core import rewrite
 
 
@@ -40,7 +41,7 @@ class TestPandasGroupby:
         x = np.arange(2 * len(dates), dtype=np.float64).reshape(2, len(dates))
         got = _climatology(x, labels, 366)
         want = _pandas_climatology(x, labels)
-        np.testing.assert_allclose(got, want, rtol=1e-10)
+        np.testing.assert_allclose(got, want, rtol=default_rtol(1e-10), atol=default_atol())
 
     def test_season_groupby(self):
         dates = pd.date_range("2000-1-1", "2004-12-31", freq="D")
@@ -48,7 +49,7 @@ class TestPandasGroupby:
         x = np.random.RandomState(0).rand(3, len(dates))
         got = _climatology(x, labels, 4)
         want = _pandas_climatology(x, labels)
-        np.testing.assert_allclose(got, want, rtol=1e-9)
+        np.testing.assert_allclose(got, want, rtol=default_rtol(1e-9), atol=default_atol())
 
     @pytest.mark.parametrize("kind", ["mean", "sum", "min", "max", "std"])
     def test_reductions_match_pandas(self, kind):
@@ -60,7 +61,7 @@ class TestPandasGroupby:
         pdf = pd.DataFrame(x.T).groupby(labels)
         want = getattr(pdf, kind)(ddof=0).to_numpy().T if kind == "std" \
             else getattr(pdf, kind)().to_numpy().T
-        np.testing.assert_allclose(got, want, rtol=1e-9)
+        np.testing.assert_allclose(got, want, rtol=default_rtol(1e-9), atol=default_atol())
 
     def test_labels_as_ramba_array_from_pandas(self):
         dates = pd.date_range("2002-1-1", "2002-12-31", freq="D")
@@ -73,7 +74,7 @@ class TestPandasGroupby:
         want = pd.DataFrame(x.T).groupby(
             np.asarray([d.month - 1 for d in dates])
         ).sum().to_numpy().T
-        np.testing.assert_allclose(got, want, rtol=1e-10)
+        np.testing.assert_allclose(got, want, rtol=default_rtol(1e-10), atol=default_atol())
 
 
 class TestRewriteFiresEndToEnd:
@@ -93,7 +94,7 @@ class TestRewriteFiresEndToEnd:
         got = stacked.asarray()  # flush happens here
         assert rewrite.stats["rewrite_stack_reduce_advindex"] > before
         want = pd.DataFrame(x.T).groupby(labels).mean().to_numpy().T
-        np.testing.assert_allclose(got, want, rtol=1e-9)
+        np.testing.assert_allclose(got, want, rtol=default_rtol(1e-9), atol=default_atol())
 
     def test_concat_binop_getitem_fires_in_flush(self):
         dates = pd.date_range("2001-1-1", "2001-12-31", freq="D")
@@ -110,7 +111,7 @@ class TestRewriteFiresEndToEnd:
         # pandas anomaly on the permuted column order
         perm = np.concatenate(cols)
         want = _pandas_climatology(x, labels)[:, perm]
-        np.testing.assert_allclose(got, want, rtol=1e-9)
+        np.testing.assert_allclose(got, want, rtol=default_rtol(1e-9), atol=default_atol())
 
 
 class TestXarrayInterop:
@@ -160,6 +161,6 @@ class TestShardedLabels:
         want = np.stack(
             [x[:, labels_np == g].mean(axis=1) for g in range(12)], axis=1
         )
-        np.testing.assert_allclose(got, want, rtol=1e-10)
+        np.testing.assert_allclose(got, want, rtol=default_rtol(1e-10), atol=default_atol())
         anom = (gb - gb.mean()).asarray()
-        np.testing.assert_allclose(anom, x - want[:, labels_np], rtol=1e-9)
+        np.testing.assert_allclose(anom, x - want[:, labels_np], rtol=default_rtol(1e-9), atol=default_atol())
