@@ -67,7 +67,8 @@ from ramba_tpu.parallel.mesh import (  # noqa: F401
 )
 from ramba_tpu.skeletons import (  # noqa: F401
     KernelTraceError, SreduceReducer, barrier, scumulative, smap, smap_index,
-    spmd, sreduce, sreduce_index, sstencil, stencil, worker_id,
+    spmd, sreduce, sreduce_index, sstencil, sstencil_iterate, stencil,
+    worker_id,
 )
 from ramba_tpu.groupby import RambaGroupby  # noqa: F401
 from ramba_tpu.fileio import Dataset, load, register_loader, save  # noqa: F401

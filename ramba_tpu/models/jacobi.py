@@ -39,16 +39,25 @@ def _kernels():
     return _KERNELS
 
 
-def jacobi2d(f, iters: int = 100, h: float = 1.0, flush_every: int = 25):
+def jacobi2d(f, iters: int = 100, h: float = 1.0, flush_every: int = 25,
+             fused_loop: bool = False):
     """Run ``iters`` Jacobi sweeps for  -lap(u) = f  with zero boundary.
 
     ``f`` is the (n, n) right-hand side (array-like or framework array);
     returns the framework array holding the iterate.
 
-    ``flush_every`` bounds the traced program to a fixed-size sweep block;
-    every block after the first has identical structure, so it reuses the
-    same compiled XLA module (the fuser's structure-keyed cache) — one
-    compile regardless of ``iters``.
+    The default chains individual ``sstencil`` sweeps; ``flush_every``
+    bounds each traced block to a fixed structure so every block after the
+    first reuses the same compiled XLA module (the fuser's structure-keyed
+    cache) — one compile no matter how ``iters`` varies across calls.
+
+    ``fused_loop=True`` instead runs all sweeps as ONE ``sstencil_iterate``
+    node — a ``lax.fori_loop`` on device, the TPU-native analogue of the
+    reference's persistent local_border halo reuse: no per-sweep dispatch
+    and no unrolled program growth, ideal when dispatch latency dominates
+    (e.g. a remote chip).  Tradeoff: ``iters`` is baked into the program,
+    so each distinct ``iters`` value compiles its own module, and
+    ``flush_every`` does not apply.
     """
     import ramba_tpu as rt
 
@@ -57,6 +66,8 @@ def jacobi2d(f, iters: int = 100, h: float = 1.0, flush_every: int = 25):
     u = rt.zeros(f.shape)
     scaled = f * (h * h)
     rt.sync()
+    if fused_loop:
+        return rt.sstencil_iterate(sweep, u, iters, scaled)
     for i in range(iters):
         u = rt.sstencil(sweep, u, scaled)
         if flush_every and (i + 1) % flush_every == 0:

@@ -1,0 +1,212 @@
+"""Round-4 perf probes on the live chip (see PERF.md "Measured on
+hardware"): separate framework overhead from XLA/hardware limits for the
+three bench sections below their rooflines.
+
+Run:  python scripts/perf_probe.py [chain|axpy|stencil|all]
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _t(fn, reps=3):
+    fn()  # compile/warm
+    return min(_t1(fn) for _ in range(reps))
+
+
+def _t1(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+def probe_dispatch_floor(rt, jnp, jax):
+    small = rt.fromarray(np.ones(8, np.float32))
+    rt.sync()
+
+    def f():
+        float(rt.sum(small))
+
+    w = _t(f, 5)
+    print(f"dispatch floor (flush+fetch tiny): {w*1e3:.2f} ms")
+
+    xs = jnp.ones(8)
+    xs.block_until_ready()
+    g = jax.jit(jnp.sum)
+
+    def f2():
+        float(g(xs))
+
+    w2 = _t(f2, 5)
+    print(f"raw jit dispatch floor:            {w2*1e3:.2f} ms")
+
+
+def probe_chain(rt, jnp, jax, n):
+    def rt_chain():
+        A = rt.arange(n) / 1000.0
+        B = rt.sin(A)
+        C = rt.cos(A)
+        D = B * B + C ** 2
+        del A, B, C
+        float(rt.sum(D))
+
+    w = _t(rt_chain)
+    print(f"rt chain n={n:.0e}: {w*1e3:.1f} ms ({4*n/1e9/w:.1f} GB/s eff)")
+
+    @jax.jit
+    def pure(n_):
+        A = jnp.arange(n, dtype=jnp.float32) / 1000.0
+        B = jnp.sin(A)
+        C = jnp.cos(A)
+        D = B * B + C ** 2
+        return D, jnp.sum(D)
+
+    def jnp_chain():
+        d, s = pure(0)
+        float(s)
+        d.delete()
+
+    w2 = _t(jnp_chain)
+    print(f"jnp chain n={n:.0e}: {w2*1e3:.1f} ms ({4*n/1e9/w2:.1f} GB/s eff)")
+
+    # transcendental cost isolation: same traffic, no sin/cos
+    @jax.jit
+    def poly(n_):
+        A = jnp.arange(n, dtype=jnp.float32) / 1000.0
+        D = A * A + A + 1.0
+        return D, jnp.sum(D)
+
+    def jnp_poly():
+        d, s = poly(0)
+        float(s)
+        d.delete()
+
+    w3 = _t(jnp_poly)
+    print(f"jnp poly  n={n:.0e}: {w3*1e3:.1f} ms ({4*n/1e9/w3:.1f} GB/s eff)")
+
+
+def probe_axpy(rt, jnp, jax):
+    for n in (100_000_000, 400_000_000):
+        x = rt.random.normal(size=n)
+        y = rt.random.normal(size=n)
+        rt.sync()
+
+        def run():
+            z = 2.5 * x + y
+            float(rt.sum(z))
+
+        w = _t(run)
+        print(f"rt axpy n={n:.0e}: {w*1e3:.2f} ms ({3*n*4/1e9/w:.1f} GB/s)")
+
+    n = 400_000_000
+    xj = jnp.asarray(np.random.rand(n).astype(np.float32))
+    yj = jnp.asarray(np.random.rand(n).astype(np.float32))
+    xj.block_until_ready(); yj.block_until_ready()
+
+    @jax.jit
+    def ax(x_, y_):
+        z = 2.5 * x_ + y_
+        return z, jnp.sum(z)
+
+    def run2():
+        z, s = ax(xj, yj)
+        float(s)
+        z.delete()
+
+    w = _t(run2)
+    print(f"jnp axpy n={n:.0e}: {w*1e3:.2f} ms ({3*n*4/1e9/w:.1f} GB/s)")
+
+
+def probe_stencil(rt, jnp, jax):
+    from ramba_tpu.ops import stencil_pallas
+
+    sn = 8192
+    x = rt.fromarray(np.random.RandomState(0).rand(sn, sn).astype(np.float32))
+    rt.sync()
+
+    @rt.stencil
+    def star2(a):
+        return (
+            0.25 * (a[0, 1] + a[0, -1] + a[1, 0] + a[-1, 0])
+            + 0.125 * (a[0, 2] + a[0, -2] + a[2, 0] + a[-2, 0])
+        )
+
+    def chain(k):
+        def f():
+            y = x
+            for _ in range(k):
+                y = rt.sstencil(star2, y)
+            float(rt.sum(y))
+        return f
+
+    for label, enabled, bh in (
+        ("pallas auto-bh", True, 0),
+        ("pallas bh=128", True, 128),
+        ("pallas bh=256", True, 256),
+        ("pallas bh=512", True, 512),
+        ("pallas bh=1024", True, 1024),
+        ("xla shifted-slice", False, 0),
+    ):
+        stencil_pallas._ENABLED = enabled
+        stencil_pallas._BH = bh
+        try:
+            w = _t(chain(10), 2) / 10
+            print(f"stencil {label}: {w*1e3:.2f} ms/iter "
+                  f"({13*(sn-4)**2/w/1e9:.0f} GFlops, "
+                  f"{2*sn*sn*4/1e9/w:.0f} GB/s)")
+        except Exception as e:  # noqa: BLE001
+            print(f"stencil {label}: FAILED {type(e).__name__}: {e}")
+    stencil_pallas._ENABLED = True
+    stencil_pallas._BH = 0
+
+    # pure-XLA reference: same star2 as shifted slices, k iters in one jit
+    xj = jnp.asarray(np.random.rand(sn, sn).astype(np.float32))
+    xj.block_until_ready()
+
+    @jax.jit
+    def sweep(a):
+        def one(a, _):
+            out = (
+                0.25 * (jnp.roll(a, -1, 1) + jnp.roll(a, 1, 1)
+                        + jnp.roll(a, -1, 0) + jnp.roll(a, 1, 0))
+                + 0.125 * (jnp.roll(a, -2, 1) + jnp.roll(a, 2, 1)
+                           + jnp.roll(a, -2, 0) + jnp.roll(a, 2, 0))
+            )
+            return out, None
+        a, _ = jax.lax.scan(one, a, None, length=10)
+        return a, jnp.sum(a)
+
+    def run():
+        a, s = sweep(xj)
+        float(s)
+        a.delete()
+
+    w = _t(run, 2) / 10
+    print(f"jnp roll-stencil (scan x10 in-jit): {w*1e3:.2f} ms/iter "
+          f"({13*(sn-4)**2/w/1e9:.0f} GFlops, {2*sn*sn*4/1e9/w:.0f} GB/s)")
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    import jax
+    import jax.numpy as jnp
+
+    import ramba_tpu as rt
+
+    print("platform:", jax.devices()[0].platform)
+    probe_dispatch_floor(rt, jnp, jax)
+    if which in ("chain", "all"):
+        probe_chain(rt, jnp, jax, 1_000_000_000)
+    if which in ("axpy", "all"):
+        probe_axpy(rt, jnp, jax)
+    if which in ("stencil", "all"):
+        probe_stencil(rt, jnp, jax)
+
+
+if __name__ == "__main__":
+    main()

@@ -36,11 +36,18 @@ class TestJacobi:
         from ramba_tpu.core import fuser
 
         f = np.ones((16, 16))
-        jacobi2d(f, iters=100, flush_every=25)  # warm the cache
+        jacobi2d(f, iters=100, flush_every=25, fused_loop=False)  # warm
         before = fuser.stats["compiles"]
-        jacobi2d(f, iters=100, flush_every=25)
+        jacobi2d(f, iters=100, flush_every=25, fused_loop=False)
         # identical block structure -> no new XLA modules
         assert fuser.stats["compiles"] == before
+
+    def test_fused_loop_matches_blockwise(self):
+        f = np.random.RandomState(2).rand(16, 16)
+        a = jacobi2d(f, iters=40, fused_loop=True).asarray()
+        b = jacobi2d(f, iters=40, fused_loop=False).asarray()
+        np.testing.assert_allclose(a, b, rtol=default_rtol(1e-12),
+                                   atol=1e-12 if x64_enabled() else 1e-6)
 
     def test_matches_numpy_sweeps(self):
         n = 24
