@@ -525,6 +525,96 @@ class TestSpmd:
         rt.spmd(w, d)
         np.testing.assert_array_equal(d.asarray(), np.ones((13, 9)))
 
+    def test_spmd_halo_1d(self):
+        # LocalView.halo: neighbor edge cells via ppermute (reference
+        # LocalNdarray.getborder, ramba.py:1260-1322)
+        import jax.numpy as jnp
+
+        n = 800
+        v = np.arange(n, dtype=float)
+        a = rt.fromarray(v.copy())
+        out = rt.zeros(n)
+        rt.sync()
+
+        def w(src, dst):
+            h = src.halo(1)
+            dst.set_local(h[:-2] + h[1:-1] + h[2:])
+
+        rt.spmd(w, a, out)
+        exp = np.zeros(n)
+        exp[1:-1] = v[:-2] + v[1:-1] + v[2:]
+        exp[0] = v[0] + v[1]
+        exp[-1] = v[-2] + v[-1]
+        np.testing.assert_array_equal(out.asarray(), exp)
+
+    def test_spmd_halo_2d_corners_sharded(self):
+        # corners must arrive (sequential per-dim exchange ships the
+        # already-extended slab)
+        n = 256
+        m = np.random.RandomState(3).rand(n, n)
+        b = rt.fromarray(m.copy())
+        o = rt.zeros((n, n))
+        rt.sync()
+
+        def w(src, dst):
+            h = src.halo(1)
+            s = sum(
+                h[1 + di:h.shape[0] - 1 + di, 1 + dj:h.shape[1] - 1 + dj]
+                for di in (-1, 0, 1) for dj in (-1, 0, 1)
+            )
+            dst.set_local(s)
+
+        rt.spmd(w, b, o)
+        mp = np.pad(m, 1)
+        exp = sum(
+            mp[1 + di:n + 1 + di, 1 + dj:n + 1 + dj]
+            for di in (-1, 0, 1) for dj in (-1, 0, 1)
+        )
+        np.testing.assert_allclose(
+            o.asarray(), exp, rtol=default_rtol(1e-12))
+
+    def test_spmd_halo_reflects_set_local(self):
+        # halo() must read the current get_local() state, not the
+        # original block
+        n = 800
+        a = rt.fromarray(np.zeros(n))
+        out = rt.zeros(n)
+        rt.sync()
+
+        def w(src, dst):
+            src.set_local(src.get_local() + 1.0)
+            dst.set_local(src.halo(1)[2:])  # right-neighbor edge included
+
+        rt.spmd(w, a, out)
+        exp = np.ones(n)
+        exp[-1] = 0.0  # beyond global edge: zero
+        np.testing.assert_array_equal(out.asarray(), exp)
+
+    def test_spmd_halo_unsharded_dim_any_depth_pads(self):
+        # review r4: the one-hop limit only applies to sharded dims; an
+        # unsharded/replicated dim pads zeros at any depth
+        small = rt.fromarray(np.arange(6.0))  # below dist threshold
+        got = {}
+        rt.sync()
+
+        def w(lv):
+            got["h"] = lv.halo(10).shape  # depth > extent: fine, zeros
+            lv.set_local(lv.get_local())
+
+        rt.spmd(w, small)
+        assert got["h"] == (26,)
+
+    def test_spmd_halo_validation(self):
+        b = rt.fromarray(np.random.RandomState(4).rand(256, 256))
+        rt.sync()
+        with pytest.raises(Exception, match="exceeds the local block"):
+            rt.spmd(lambda lv: lv.set_local(
+                lv.halo(10 ** 6)[:lv.shape[0], :lv.shape[1]]), b)
+        from ramba_tpu.skeletons import LocalView
+
+        with pytest.raises(ValueError, match="inside spmd"):
+            LocalView(np.ones(4)).halo(1)
+
     def test_barrier(self):
         rt.barrier()
 
