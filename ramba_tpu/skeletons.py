@@ -1001,11 +1001,13 @@ class LocalView:
             names = _spec_entry_names(spec[d])
             nshards = _shard_count(mesh, names)
             if nshards > 1:
-                if depth[d] > self._block.shape[d]:
-                    # one ppermute hop reaches only the adjacent shard
+                if depth[d] > x.shape[d]:
+                    # one ppermute hop reaches only the adjacent shard;
+                    # check the CURRENT extent (set_local may have
+                    # changed it), not the original block's
                     raise ValueError(
                         f"halo depth {depth[d]} exceeds the local block "
-                        f"extent {self._block.shape[d]} along dim {d}"
+                        f"extent {x.shape[d]} along dim {d}"
                     )
                 x = _exchange(x, d, names, nshards, depth[d], depth[d])
             else:
