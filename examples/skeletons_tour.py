@@ -49,3 +49,26 @@ temps = rt.fromarray(np.random.RandomState(1).rand(8, 365))
 gb = temps.groupby(1, days, num_groups=7)
 anomaly = gb - gb.mean()
 print("groupby anomaly shape:", anomaly.shape)
+
+# LocalView.halo: neighbor shard access inside an spmd kernel (the
+# reference's getborder surface) — here a 3-point smoothing sweep
+src = rt.fromarray(np.arange(4096.0))
+dst = rt.zeros(4096)
+rt.sync()
+
+def smooth(s, d):
+    h = s.halo(1)                      # block + 1 neighbor cell each side
+    d.set_local((h[:-2] + h[1:-1] + h[2:]) / 3.0)
+
+rt.spmd(smooth, src, dst)
+print("spmd halo smooth:", float(dst[2048]))
+
+# sstencil_iterate: many sweeps in ONE compiled on-device loop — the
+# device-resident replacement for per-sweep dispatch
+@rt.stencil
+def jacobi(a):
+    return 0.25 * (a[-1, 0] + a[1, 0] + a[0, -1] + a[0, 1])
+
+grid = rt.fromarray(np.random.RandomState(2).rand(256, 256))
+relaxed = rt.sstencil_iterate(jacobi, grid, 100)   # 100 sweeps, one program
+print("sstencil_iterate(100):", float(rt.mean(relaxed)))

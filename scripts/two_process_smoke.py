@@ -79,6 +79,45 @@ def worker(rank: int, port: int) -> None:
     except NotImplementedError:
         pass
 
+    # the skeleton surface across the process boundary (round 4): a
+    # 3-point spmd halo sweep — the ppermute crosses processes — and a
+    # fori_loop stencil; verification is by collective checksum (a global
+    # array is not fully addressable from one controller)
+    import numpy as np
+
+    v = np.arange(float(n))
+    src = rt.arange(n, dtype=float)
+    out = rt.zeros(n)
+    rt.sync()
+
+    def sweep(s_, d_):
+        h = s_.halo(1)
+        d_.set_local(h[:-2] + h[1:-1] + h[2:])
+
+    rt.spmd(sweep, src, out)
+    exp = np.zeros(n)
+    exp[1:-1] = v[:-2] + v[1:-1] + v[2:]
+    exp[0] = v[0] + v[1]
+    exp[-1] = v[-2] + v[-1]
+    got = float(rt.sum(out * out))
+    want = float((exp * exp).sum())
+    # f32 regime: the checksum accumulates 4096 terms of ~1e8
+    assert abs(got - want) <= 1e-5 * max(1.0, abs(want)), (got, want)
+
+    @rt.stencil
+    def avg3(a):
+        return (a[-1] + a[0] + a[1]) / 3.0
+
+    it = rt.sstencil_iterate(avg3, src, 3)
+    e = v.copy()
+    for _ in range(3):
+        nxt = np.zeros_like(e)
+        nxt[1:-1] = (e[:-2] + e[1:-1] + e[2:]) / 3.0
+        e = nxt
+    got = float(rt.sum(it))
+    want = float(e.sum())
+    assert abs(got - want) <= 1e-5 * max(1.0, abs(want)), (got, want)
+
     # driver gating (reference: in_driver() in MPI SPMD mode)
     if distributed.in_driver():
         assert rank == 0
