@@ -66,9 +66,9 @@ from ramba_tpu.parallel.mesh import (  # noqa: F401
     get_mesh, num_workers, set_mesh,
 )
 from ramba_tpu.skeletons import (  # noqa: F401
-    KernelTraceError, SreduceReducer, barrier, scumulative, smap, smap_index,
-    spmd, sreduce, sreduce_index, sstencil, sstencil_iterate, stencil,
-    worker_id,
+    KernelTraceError, LocalView, SreduceReducer, barrier, scumulative, smap,
+    smap_index, spmd, sreduce, sreduce_index, sstencil, sstencil_iterate,
+    stencil, worker_id,
 )
 from ramba_tpu.groupby import RambaGroupby  # noqa: F401
 from ramba_tpu.fileio import Dataset, load, register_loader, save  # noqa: F401
@@ -82,7 +82,8 @@ from ramba_tpu.utils.remote import get, jit, remote  # noqa: F401
 from ramba_tpu.utils import debug  # noqa: F401
 from ramba_tpu.utils import timing  # noqa: F401
 from ramba_tpu.utils.timing import (  # noqa: F401
-    annotate, get_timing, print_comm_stats, profiler_trace, timing_summary,
+    add_sub_time, add_time, annotate, get_timing, get_timing_str,
+    print_comm_stats, profiler_trace, time_dict, timing_summary,
 )
 from ramba_tpu.utils.timing import reset as reset_timing  # noqa: F401
 
@@ -118,6 +119,27 @@ except Exception:  # pragma: no cover
 
 float_ = _np.float64
 int_ = _np.int64
+
+# C-named aliases + info objects the reference re-exports from numpy
+# (/root/reference/ramba/__init__.py:20) so `ramba.double` etc. keep working
+byte = _np.byte
+ubyte = _np.ubyte
+short = _np.short
+ushort = _np.ushort
+intc = _np.intc
+uintc = _np.uintc
+uint = _np.uint
+longlong = _np.longlong
+ulonglong = _np.ulonglong
+half = _np.half
+single = _np.single
+double = _np.double
+longdouble = _np.longdouble
+csingle = _np.csingle
+cdouble = _np.cdouble
+clongdouble = _np.clongdouble
+iinfo = _np.iinfo
+finfo = _np.finfo
 
 
 def init():

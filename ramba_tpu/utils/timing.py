@@ -131,14 +131,43 @@ def get_timing() -> dict:
     }
 
 
+def get_timing_str(details: bool = False) -> str:
+    """Formatted timer report (reference: get_timing_str,
+    ramba.py:985-997): one ``name: seconds s (count)`` line per timer;
+    ``details`` appends sub-timer lines."""
+    # include parents that only ever received sub-times (add_sub_time does
+    # not require a prior add_time here, unlike the reference)
+    parents = list(time_dict)
+    parents += [p for p, _ in sub_time_dict if p not in time_dict]
+    seen = set()
+    lines = []
+    for k in parents:
+        if k in seen:
+            continue
+        seen.add(k)
+        if k in time_dict:
+            secs, cnt = time_dict[k]
+            lines.append(f"{k}: {secs}s({cnt})")
+        else:
+            lines.append(f"{k}:")
+        if details:
+            for (parent, sub), (ssecs, scnt) in sub_time_dict.items():
+                if parent == k:
+                    lines.append(f"  {sub}: {ssecs}s({scnt})")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
 def timing_summary(file=None) -> None:
     """Human-readable dump (reference: timing_summary at exit,
     ramba.py:7620-7627)."""
     file = file or sys.stderr
-    if not (time_dict or per_func):
+    if not (time_dict or sub_time_dict or per_func):
         return
     print("=== ramba_tpu timing summary ===", file=file)
-    for name, (tot, cnt) in sorted(time_dict.items(), key=lambda kv: -kv[1][0]):
+    orphans = {p for p, _ in sub_time_dict if p not in time_dict}
+    top = sorted(time_dict.items(), key=lambda kv: -kv[1][0])
+    top += [(p, (0.0, 0)) for p in sorted(orphans)]
+    for name, (tot, cnt) in top:
         print(f"  {name:<28s} {tot:10.4f}s  x{cnt}", file=file)
         for (parent, sub), (stot, scnt) in sorted(sub_time_dict.items()):
             if parent == name:

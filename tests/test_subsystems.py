@@ -430,6 +430,29 @@ class TestApiParity:
         assert st["device_to_host_bytes"] >= nbytes
         rt.print_comm_stats(file=None)  # prints to stderr
 
+    def test_timing_str_and_passthroughs(self):
+        # reference surface: module-level add_time/add_sub_time/time_dict/
+        # get_timing_str (ramba.py:985-1019); orphan sub-timers must be
+        # visible in reports (review r4)
+        rt.reset_timing()
+        rt.add_time("flush", 0.25)
+        rt.add_sub_time("flush", "compile", 0.1)
+        rt.add_sub_time("orphan", "x", 0.1)
+        s = rt.get_timing_str(details=True)
+        assert "flush: 0.25s(1)" in s and "compile: 0.1s(1)" in s, s
+        assert "orphan" in s and "x: 0.1s(1)" in s, s
+        assert "flush" in rt.time_dict
+        rt.reset_timing()
+
+    def test_numpy_alias_reexports(self):
+        # /root/reference/ramba/__init__.py:20 re-exports numpy C-named
+        # aliases; drop-in users reference them as ramba.double etc.
+        for name in ("byte", "short", "intc", "uint", "half", "single",
+                     "double", "longdouble", "csingle", "cdouble"):
+            assert getattr(rt, name) is getattr(np, name), name
+        assert rt.iinfo(rt.int32).max == 2 ** 31 - 1
+        assert rt.finfo(np.float32).eps == np.finfo(np.float32).eps
+
     def test_reset_timing(self):
         rt.timing.add_time("x", 1.0)
         rt.reset_timing()
