@@ -1,0 +1,133 @@
+"""Seeded differential fuzz over the skeleton surface.
+
+Extends the op-pipeline fuzzer (test_fuzz.py) to the structured-parallelism
+APIs: random traceable kernels drive smap/smap_index, random-offset stencil
+kernels drive sstencil/sstencil_iterate, and random reducers drive
+sreduce/scumulative — each op descriptor carries BOTH the framework
+application and a numpy reference built from the same parameters, so the
+comparison can never drift from the generator.  Seeds are fixed so
+failures reproduce.
+"""
+
+import numpy as np
+import pytest
+
+import ramba_tpu as rt
+from tests.helpers import default_atol, default_rtol
+
+
+def _mk_smap(rng):
+    c = float(rng.uniform(0.5, 2.0))
+    kind = rng.randint(4)
+    if kind == 0:
+        return (lambda x: x * c + 1.0), (lambda v: v * c + 1.0)
+    if kind == 1:
+        return (lambda x: np.maximum(x, c)), (lambda v: np.maximum(v, c))
+    if kind == 2:
+        return (
+            lambda x: np.where(x > c, x * 2.0, -x),
+            lambda v: np.where(v > c, v * 2.0, -v),
+        )
+    return (lambda x: np.tanh(x)), (lambda v: np.tanh(v))
+
+
+def _mk_stencil_1d(rng):
+    offs = sorted(set(int(o) for o in rng.randint(-2, 3, size=3)))
+    ws = [float(rng.uniform(-1, 1)) for _ in offs]
+
+    def kern(a, _offs=tuple(offs), _ws=tuple(ws)):
+        s = a[0] * 0.0
+        for o, w in zip(_offs, _ws):
+            s = s + a[o] * w
+        return s
+
+    lo, hi = -min(min(offs), 0), max(max(offs), 0)
+
+    def ref(v):
+        out = np.zeros_like(v)
+        n = v.size
+        core = slice(lo, n - hi if hi else None)
+        acc = np.zeros(n - lo - hi)
+        for o, w in zip(offs, ws):
+            acc = acc + v[lo + o: n - hi + o if (n - hi + o) else None] * w
+        out[core] = acc
+        return out
+
+    return kern, ref
+
+
+def _mk_cumul(rng):
+    kind = rng.randint(2)
+    if kind == 0:
+        return (
+            lambda x, c: x + c,
+            lambda c, b: b + c,
+            np.cumsum,
+            None,
+        )
+    return (
+        lambda x, c: np.maximum(x, c),
+        lambda c, b: np.maximum(b, c),
+        np.maximum.accumulate,
+        None,
+    )
+
+
+def _check(seed):
+    rng = np.random.RandomState(seed)
+    n = int(rng.randint(64, 4097))
+    v = rng.rand(n)
+    got = rt.fromarray(v.copy())
+    want = v.copy()
+
+    for _ in range(rng.randint(2, 5)):
+        # smap gets double weight (cheapest op; keeps pipelines varied)
+        c = rng.randint(4)
+        if c in (0, 3):
+            k, ref = _mk_smap(rng)
+            got = rt.smap(k, got)
+            want = ref(want)
+        elif c == 1:
+            kern, ref = _mk_stencil_1d(rng)
+            st = rt.stencil(kern)
+            iters = int(rng.randint(1, 4))
+            if rng.randint(2):
+                got = rt.sstencil_iterate(st, got, iters)
+            else:
+                for _ in range(iters):
+                    got = rt.sstencil(st, got)
+            for _ in range(iters):
+                want = ref(want)
+        else:  # c == 2
+            local, fin, ref, _ = _mk_cumul(rng)
+            got = rt.scumulative(local, fin, got)
+            want = ref(want)
+
+    np.testing.assert_allclose(
+        np.asarray(got), want,
+        rtol=default_rtol(1e-8), atol=default_atol(),
+        err_msg=f"seed {seed}",
+    )
+
+    # one reduction at the end (sreduce over the final state)
+    total = float(
+        rt.sreduce(lambda x: x, lambda a, b: a + b, 0.0, rt.fromarray(want))
+    )
+    assert abs(total - want.sum()) <= max(
+        default_atol(), default_rtol(1e-8) * abs(want.sum())
+    ), (seed, total, want.sum())
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_skeleton_program(seed):
+    _check(seed)
+
+
+@pytest.mark.skipif(
+    not __import__("os").environ.get("RAMBA_TPU_FUZZ_WIDE"),
+    reason="set RAMBA_TPU_FUZZ_WIDE=1 for the wide sweep",
+)
+@pytest.mark.parametrize("block", range(5))
+def test_skeleton_program_wide(block):
+    for seed in range(25 + block * 35, 25 + (block + 1) * 35):
+        _check(seed)
