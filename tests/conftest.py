@@ -21,8 +21,14 @@ jax_platforms, so we override through jax.config rather than the env var.
 
 import os
 
+# Device-count leg (reference CI runs the identical suite at 2 workers AND
+# a larger count, python-package.yml:40-46): RAMBA_TEST_DEVICES=2 re-runs
+# everything on a 2-device mesh; default 8.
+N_DEVICES = int(os.environ.get("RAMBA_TEST_DEVICES", "8"))
+
 os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    os.environ.get("XLA_FLAGS", "")
+    + f" --xla_force_host_platform_device_count={N_DEVICES}"
 )
 
 X64 = os.environ.get("RAMBA_TEST_X64", "1") not in ("0", "")

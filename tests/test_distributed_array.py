@@ -1023,27 +1023,30 @@ class TestDistributionArgument:
             lambda d: rt.full((n, 8), 3.0, distribution=d),
             lambda d: rt.fromfunction(lambda i, j: i + j, (n, 8), distribution=d),
         ):
-            # (8, 1): explicit split counts -> realized with whatever mesh
-            # axes multiply to 8; P("d0"): raw spec -> d0-way split
-            for dist, rows in (((8, 1), n // 8), (P("d0"), n // d0)):
+            # (nw, 1): explicit split counts -> realized with whatever mesh
+            # axes multiply to nw; P("d0"): raw spec -> d0-way split
+            nw = rt.num_workers()
+            for dist, rows in (((nw, 1), n // nw), (P("d0"), n // d0)):
                 a = make(dist)
                 assert a.shape == (n, 8)
                 v = a._value()
-                assert len(v.addressable_shards) == 8
+                assert len(v.addressable_shards) == nw
                 assert v.addressable_shards[0].data.shape[0] == rows
 
     def test_arange_linspace_distribution(self):
-        a = rt.arange(4096, distribution=(8,))
-        assert len(a._value().addressable_shards) == 8
-        le = rt.linspace(0.0, 1.0, 4096, distribution=(8,))
+        nw = rt.num_workers()
+        a = rt.arange(4096, distribution=(nw,))
+        assert len(a._value().addressable_shards) == nw
+        le = rt.linspace(0.0, 1.0, 4096, distribution=(nw,))
         np.testing.assert_allclose(le.asarray(), np.linspace(0.0, 1.0, 4096))
 
     def test_elementwise_preserves_distribution(self):
         # docs: 'Elementwise operations on such arrays maintain this selected
         # partitioning on the output arrays' — GSPMD propagates shardings
-        a = rt.zeros((1024, 8), distribution=(8, 1)) + 1.0
+        nw = rt.num_workers()
+        a = rt.zeros((1024, 8), distribution=(nw, 1)) + 1.0
         v = a._value()
-        assert v.addressable_shards[0].data.shape[0] == 1024 // 8
+        assert v.addressable_shards[0].data.shape[0] == 1024 // nw
 
 
 class TestFlags:

@@ -9,6 +9,8 @@ comparison can never drift from the generator.  Seeds are fixed so
 failures reproduce.
 """
 
+import os
+
 import numpy as np
 import pytest
 
@@ -49,7 +51,7 @@ def _mk_stencil_1d(rng):
         core = slice(lo, n - hi if hi else None)
         acc = np.zeros(n - lo - hi)
         for o, w in zip(offs, ws):
-            acc = acc + v[lo + o: n - hi + o if (n - hi + o) else None] * w
+            acc = acc + v[lo + o: n - hi + o] * w
         out[core] = acc
         return out
 
@@ -124,7 +126,7 @@ def test_skeleton_program(seed):
 
 
 @pytest.mark.skipif(
-    not __import__("os").environ.get("RAMBA_TPU_FUZZ_WIDE"),
+    not os.environ.get("RAMBA_TPU_FUZZ_WIDE"),
     reason="set RAMBA_TPU_FUZZ_WIDE=1 for the wide sweep",
 )
 @pytest.mark.parametrize("block", range(5))

@@ -319,11 +319,15 @@ class TestDistributed:
         rt.distributed.initialize()  # must not raise on single host
 
     def test_global_mesh(self):
+        import jax
+
         m = rt.distributed.global_mesh()
-        assert m.devices.size == 8
+        assert m.devices.size == len(jax.devices())
 
     def test_local_devices(self):
-        assert len(rt.distributed.local_devices()) == 8
+        import jax
+
+        assert len(rt.distributed.local_devices()) == len(jax.devices())
 
 
 class TestPersistentCache:
@@ -476,6 +480,8 @@ class TestApiParityReviewFixes:
 
         from ramba_tpu.parallel.mesh import spec_from_splits
 
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 devices for the (2,2,2) mesh")
         devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
         mesh = Mesh(devs, axis_names=("a", "b", "c"))
         spec = spec_from_splits((4,), mesh)
