@@ -53,10 +53,20 @@ from ramba_tpu.ops.manipulation import (  # noqa: F401
     squeeze, stack, swapaxes, take, tile, transpose, tril, triu, vstack,
 )
 from ramba_tpu.ops.extras import (  # noqa: F401
-    append, argwhere, bincount, compress, convolve, corrcoef, correlate, cov,
-    cross, delete, diff, digitize, divmod, ediff1d, extract, flatnonzero,
-    gradient, histogram, in1d, insert, interp, intersect1d, isin, kron, modf,
-    nan_to_num, nonzero, searchsorted, setdiff1d, union1d, unique, unwrap,
+    append, apply_along_axis, apply_over_axes, argpartition, argwhere,
+    around, array_equiv, atleast_3d, bartlett, bincount, blackman,
+    broadcast_arrays, compress, convolve, corrcoef, correlate, cov, cross,
+    delete, diag_indices, diagonal, diff, digitize, divmod, dsplit, ediff1d,
+    extract, fill_diagonal, fix, flatnonzero, fliplr, flipud, frexp,
+    gradient, hamming, hanning, histogram, hsplit, in1d, insert, interp,
+    intersect1d, isin, ix_, kaiser, kron, modf, nan_to_num,
+    nancumprod, nancumsum, nanmedian, nanpercentile, nanquantile, nonzero,
+    partition, percentile, piecewise, place, poly, polyfit, polyval,
+    put_along_axis, putmask, quantile, ravel_multi_index, real_if_close,
+    resize, roots, rot90, row_stack, searchsorted, setdiff1d, setxor1d,
+    take_along_axis, trapezoid, trapz, tril_indices, tril_indices_from,
+    trim_zeros, triu_indices, triu_indices_from, union1d, unique,
+    unravel_index, unwrap, vander, vsplit,
 )
 from ramba_tpu.ops.linalg import (  # noqa: F401
     dot, einsum, inner, matmul, outer, set_matmul_precision, tensordot,
@@ -170,6 +180,22 @@ def _register_numpy_dispatch():
         "dot", "matmul", "inner", "outer", "tensordot", "einsum", "trace",
         "vdot", "zeros_like", "ones_like", "empty_like", "full_like", "copy",
         "asarray",
+        # round-4 breadth batch (ops/extras.py)
+        "rot90", "fliplr", "flipud", "atleast_3d", "fix", "around",
+        "nancumsum", "nancumprod", "quantile", "percentile", "nanquantile",
+        "nanpercentile", "nanmedian", "take_along_axis", "diagonal",
+        "trapezoid", "vander", "polyval", "frexp", "broadcast_arrays",
+        "vsplit", "hsplit", "dsplit", "partition", "argpartition",
+        "setxor1d", "array_equiv", "trim_zeros", "resize", "poly",
+        "polyfit", "roots", "real_if_close", "piecewise",
+        "apply_along_axis", "apply_over_axes", "fill_diagonal", "putmask",
+        "place", "put_along_axis", "diff", "gradient", "cross", "kron",
+        "searchsorted", "interp", "unwrap", "digitize", "bincount",
+        "histogram", "unique", "nonzero", "flatnonzero", "argwhere",
+        "isin", "in1d", "intersect1d", "union1d", "setdiff1d", "append",
+        "insert", "delete", "compress", "extract", "convolve", "correlate",
+        "cov", "corrcoef", "modf", "divmod", "nan_to_num", "ediff1d",
+        "row_stack",
     ]
     for n in names:
         np_fn = getattr(_np, n, None)

@@ -1,0 +1,291 @@
+"""Differential tests for the round-4 NumPy-breadth batch (ops/extras.py):
+the remaining common numpy names a drop-in user reaches for — lazily
+lowered, host index helpers, window generators, host-boundary fallbacks,
+and numpy's in-place mutators expressed through the write-back machinery.
+"""
+
+import numpy as np
+import pytest
+
+import ramba_tpu as rt
+from tests.helpers import default_atol, default_rtol
+
+
+def _cmp(got, want, rtol=1e-9):
+    got = np.asarray(got) if not isinstance(got, (list, tuple)) else got
+    if isinstance(want, (list, tuple)):
+        assert len(got) == len(want)
+        for g, w in zip(got, want):
+            _cmp(g, w, rtol)
+        return
+    np.testing.assert_allclose(
+        np.asarray(got), want, rtol=default_rtol(rtol), atol=default_atol()
+    )
+
+
+class TestLazyLowered:
+    def test_rot_flip(self):
+        m = np.arange(12.0).reshape(3, 4)
+        _cmp(rt.rot90(rt.fromarray(m)), np.rot90(m))
+        _cmp(rt.rot90(rt.fromarray(m), 2), np.rot90(m, 2))
+        _cmp(rt.fliplr(rt.fromarray(m)), np.fliplr(m))
+        _cmp(rt.flipud(rt.fromarray(m)), np.flipud(m))
+
+    def test_atleast_3d(self):
+        v = np.arange(5.0)
+        assert np.asarray(rt.atleast_3d(rt.fromarray(v))).shape == \
+            np.atleast_3d(v).shape
+
+    def test_fix_around(self):
+        v = np.array([-1.7, -0.2, 0.2, 1.7])
+        _cmp(rt.fix(rt.fromarray(v)), np.fix(v))
+        _cmp(rt.around(rt.fromarray(v), 0), np.around(v, 0))
+
+    def test_nancum(self):
+        v = np.array([1.0, np.nan, 2.0, np.nan, 3.0])
+        _cmp(rt.nancumsum(rt.fromarray(v)), np.nancumsum(v))
+        _cmp(rt.nancumprod(rt.fromarray(v)), np.nancumprod(v))
+
+    def test_quantiles(self):
+        v = np.random.RandomState(0).rand(101)
+        a = rt.fromarray(v)
+        _cmp(rt.quantile(a, 0.5), np.quantile(v, 0.5), rtol=1e-6)
+        _cmp(rt.percentile(a, [25, 75]), np.percentile(v, [25, 75]),
+             rtol=1e-6)
+        _cmp(rt.median(a), np.median(v), rtol=1e-6)
+        w = v.copy()
+        w[::7] = np.nan
+        b = rt.fromarray(w)
+        _cmp(rt.nanquantile(b, 0.5), np.nanquantile(w, 0.5), rtol=1e-6)
+        _cmp(rt.nanpercentile(b, 30), np.nanpercentile(w, 30), rtol=1e-6)
+        _cmp(rt.nanmedian(b), np.nanmedian(w), rtol=1e-6)
+
+    def test_quantile_axis(self):
+        v = np.random.RandomState(1).rand(8, 16)
+        _cmp(rt.quantile(rt.fromarray(v), 0.25, axis=1),
+             np.quantile(v, 0.25, axis=1), rtol=1e-6)
+
+    def test_take_along_axis(self):
+        v = np.random.RandomState(2).rand(6, 5)
+        idx = np.argsort(v, axis=1)
+        got = rt.take_along_axis(rt.fromarray(v), rt.fromarray(idx), 1)
+        _cmp(got, np.take_along_axis(v, idx, 1))
+
+    def test_diagonal(self):
+        m = np.arange(24.0).reshape(4, 6)
+        _cmp(rt.diagonal(rt.fromarray(m)), np.diagonal(m))
+        _cmp(rt.diagonal(rt.fromarray(m), 1), np.diagonal(m, 1))
+
+    def test_trapezoid(self):
+        y = np.random.RandomState(3).rand(64)
+        x = np.sort(np.random.RandomState(4).rand(64))
+        _cmp(rt.trapezoid(rt.fromarray(y)), np.trapezoid(y), rtol=1e-6)
+        _cmp(rt.trapz(rt.fromarray(y), rt.fromarray(x)),
+             np.trapezoid(y, x), rtol=1e-6)
+        _cmp(rt.trapezoid(rt.fromarray(y), dx=0.5),
+             np.trapezoid(y, dx=0.5), rtol=1e-6)
+
+    def test_vander_polyval(self):
+        x = np.array([1.0, 2.0, 3.0])
+        _cmp(rt.vander(rt.fromarray(x)), np.vander(x))
+        _cmp(rt.vander(rt.fromarray(x), 2, increasing=True),
+             np.vander(x, 2, increasing=True))
+        p = np.array([2.0, 0.0, 1.0])
+        _cmp(rt.polyval(rt.fromarray(p), rt.fromarray(x)), np.polyval(p, x))
+
+    def test_frexp(self):
+        v = np.array([0.5, 3.0, -6.25, 0.0])
+        gm, ge = rt.frexp(rt.fromarray(v))
+        wm, we = np.frexp(v)
+        _cmp(gm, wm)
+        np.testing.assert_array_equal(np.asarray(ge), we)
+
+    def test_broadcast_arrays(self):
+        a = np.arange(3.0)
+        b = np.arange(4.0)[:, None]
+        ga, gb = rt.broadcast_arrays(rt.fromarray(a), rt.fromarray(b))
+        wa, wb = np.broadcast_arrays(a, b)
+        _cmp(ga, wa)
+        _cmp(gb, wb)
+
+
+class TestSplitsStacks:
+    def test_vsplit_hsplit_dsplit(self):
+        m = np.arange(48.0).reshape(4, 4, 3)
+        for g, w in zip(rt.vsplit(rt.fromarray(m), 2), np.vsplit(m, 2)):
+            _cmp(g, w)
+        for g, w in zip(rt.hsplit(rt.fromarray(m), 2), np.hsplit(m, 2)):
+            _cmp(g, w)
+        for g, w in zip(rt.dsplit(rt.fromarray(m), 3), np.dsplit(m, 3)):
+            _cmp(g, w)
+
+    def test_row_stack(self):
+        a = np.arange(4.0)
+        _cmp(rt.row_stack([rt.fromarray(a), rt.fromarray(a * 2)]),
+             np.vstack([a, a * 2]))
+
+
+class TestIndexHelpers:
+    def test_tri_diag_indices(self):
+        assert all(
+            (np.asarray(g) == w).all()
+            for g, w in zip(rt.tril_indices(4), np.tril_indices(4))
+        )
+        assert all(
+            (np.asarray(g) == w).all()
+            for g, w in zip(rt.diag_indices(3), np.diag_indices(3))
+        )
+
+    def test_unravel_ravel(self):
+        idx = rt.unravel_index(np.array([5, 11]), (3, 4))
+        widx = np.unravel_index(np.array([5, 11]), (3, 4))
+        for g, w in zip(idx, widx):
+            np.testing.assert_array_equal(g, w)
+        back = rt.ravel_multi_index(idx, (3, 4))
+        np.testing.assert_array_equal(back, [5, 11])
+
+    def test_ix_(self):
+        grids = rt.ix_(np.array([0, 2]), np.array([1, 3]))
+        wgrids = np.ix_(np.array([0, 2]), np.array([1, 3]))
+        for g, w in zip(grids, wgrids):
+            np.testing.assert_array_equal(g, w)
+
+
+class TestWindows:
+    @pytest.mark.parametrize("name", ["bartlett", "blackman", "hamming",
+                                      "hanning"])
+    def test_windows(self, name):
+        _cmp(getattr(rt, name)(16), getattr(np, name)(16), rtol=1e-6)
+
+    def test_kaiser(self):
+        _cmp(rt.kaiser(16, 8.6), np.kaiser(16, 8.6), rtol=1e-6)
+
+
+class TestHostBoundary:
+    def test_partition(self):
+        v = np.random.RandomState(5).rand(32)
+        got = rt.partition(rt.fromarray(v), 10)
+        assert (got[:10] <= got[10]).all() and (got[11:] >= got[10]).all()
+        gi = rt.argpartition(rt.fromarray(v), 10)
+        assert (v[gi[:10]] <= v[gi[10]]).all()
+
+    def test_set_ops_equiv(self):
+        a = np.array([1, 2, 3, 4])
+        b = np.array([3, 4, 5])
+        np.testing.assert_array_equal(
+            rt.setxor1d(rt.fromarray(a), rt.fromarray(b)), np.setxor1d(a, b))
+        assert rt.array_equiv(rt.fromarray(a), rt.fromarray(a.copy()))
+        assert not rt.array_equiv(rt.fromarray(a), rt.fromarray(b))
+
+    def test_trim_resize(self):
+        v = np.array([0.0, 0.0, 1.0, 2.0, 0.0])
+        np.testing.assert_array_equal(rt.trim_zeros(rt.fromarray(v)),
+                                      np.trim_zeros(v))
+        _cmp(rt.resize(rt.fromarray(np.arange(4.0)), (3, 3)),
+             np.resize(np.arange(4.0), (3, 3)))
+
+    def test_poly_roots_fit(self):
+        z = np.array([1.0, 2.0])
+        np.testing.assert_allclose(rt.poly(rt.fromarray(z)), np.poly(z))
+        r = rt.roots(rt.fromarray(np.array([1.0, -3.0, 2.0])))
+        np.testing.assert_allclose(sorted(r.real), [1.0, 2.0], atol=1e-8)
+        x = np.arange(8.0)
+        y = 3 * x + 1
+        c = rt.polyfit(rt.fromarray(x), rt.fromarray(y), 1)
+        np.testing.assert_allclose(c, [3.0, 1.0], atol=1e-6)
+
+    def test_real_if_close_piecewise_apply(self):
+        c = np.array([1 + 1e-15j, 2 + 1e-16j])
+        assert np.asarray(rt.real_if_close(rt.fromarray(c))).dtype.kind == "f"
+        x = np.linspace(-2, 2, 9)
+        got = rt.piecewise(rt.fromarray(x), [x < 0, x >= 0],
+                           [lambda v: -v, lambda v: v * 2])
+        np.testing.assert_allclose(got, np.piecewise(
+            x, [x < 0, x >= 0], [lambda v: -v, lambda v: v * 2]))
+        m = np.arange(12.0).reshape(3, 4)
+        np.testing.assert_allclose(
+            rt.apply_along_axis(np.sum, 1, rt.fromarray(m)),
+            np.apply_along_axis(np.sum, 1, m))
+        np.testing.assert_allclose(
+            rt.apply_over_axes(np.sum, rt.fromarray(m), [0]),
+            np.apply_over_axes(np.sum, m, [0]))
+
+
+class TestMutators:
+    def test_fill_diagonal(self):
+        a = rt.fromarray(np.zeros((4, 4)))
+        rt.fill_diagonal(a, 7.0)
+        w = np.zeros((4, 4))
+        np.fill_diagonal(w, 7.0)
+        np.testing.assert_array_equal(np.asarray(a), w)
+
+    def test_putmask_place(self):
+        v = np.arange(8.0)
+        a = rt.fromarray(v.copy())
+        rt.putmask(a, np.asarray(v) > 4, np.array([-1.0, -2.0]))
+        w = v.copy()
+        np.putmask(w, v > 4, np.array([-1.0, -2.0]))
+        np.testing.assert_array_equal(np.asarray(a), w)
+
+        b = rt.fromarray(v.copy())
+        rt.place(b, v % 2 == 0, np.array([9.0]))
+        w2 = v.copy()
+        np.place(w2, v % 2 == 0, np.array([9.0]))
+        np.testing.assert_array_equal(np.asarray(b), w2)
+
+    def test_put_along_axis(self):
+        v = np.random.RandomState(6).rand(4, 5)
+        a = rt.fromarray(v.copy())
+        idx = np.argmax(v, axis=1, keepdims=True)
+        rt.put_along_axis(a, idx, 0.0, 1)
+        w = v.copy()
+        np.put_along_axis(w, idx, 0.0, 1)
+        _cmp(np.asarray(a), w)
+
+
+class TestNumpyDispatch:
+    def test_np_namespace_routes_to_framework(self):
+        # np.<fn>(rt_array) must dispatch through __array_function__ for the
+        # breadth batch, not fall back to host numpy conversion
+        v = np.random.RandomState(7).rand(64)
+        a = rt.fromarray(v)
+        _cmp(np.median(a), np.median(v), rtol=1e-6)
+        _cmp(np.percentile(a, 25), np.percentile(v, 25), rtol=1e-6)
+        m = np.arange(12.0).reshape(3, 4)
+        rm = rt.fromarray(m)
+        got = np.rot90(rm)
+        assert isinstance(got, type(rm))  # stayed a framework array
+        _cmp(got, np.rot90(m))
+        _cmp(np.diagonal(rm), np.diagonal(m))
+        _cmp(np.take_along_axis(rm, rt.fromarray(np.argsort(m, axis=1)), 1),
+             np.take_along_axis(m, np.argsort(m, axis=1), 1))
+
+
+class TestReviewRegressions:
+    def test_median_keeps_out_support(self):
+        # review r4: the breadth batch must not shadow reductions.median
+        v = np.random.RandomState(8).rand(32)
+        buf = rt.zeros(())
+        r = rt.median(rt.fromarray(v), out=buf)
+        np.testing.assert_allclose(float(buf), np.median(v),
+                                   rtol=default_rtol(1e-9))
+        assert r is buf
+
+    def test_split_dim_guards(self):
+        with pytest.raises(ValueError, match="2 or more"):
+            rt.vsplit(rt.fromarray(np.arange(4.0)), 2)
+        with pytest.raises(ValueError, match="3 or more"):
+            rt.dsplit(rt.fromarray(np.arange(4.0).reshape(2, 2)), 2)
+
+    def test_take_along_axis_none_flattens(self):
+        v = np.random.RandomState(9).rand(3, 4)
+        idx = np.array([5, 0, 11])
+        _cmp(rt.take_along_axis(rt.fromarray(v), rt.fromarray(idx), None),
+             np.take_along_axis(v, idx, None))
+
+    def test_frexp_single_eval_edge_cases(self):
+        v = np.array([0.0, np.inf, -np.inf, 0.5, 1024.0, -3.75])
+        gm, ge = rt.frexp(rt.fromarray(v))
+        wm, we = np.frexp(v)
+        _cmp(gm, wm)
+        np.testing.assert_array_equal(np.asarray(ge), we)
