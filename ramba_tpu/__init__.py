@@ -80,6 +80,7 @@ from ramba_tpu.skeletons import (  # noqa: F401
     smap_index, spmd, sreduce, sreduce_index, sstencil, sstencil_iterate,
     stencil, worker_id,
 )
+from ramba_tpu import linalg  # noqa: F401
 from ramba_tpu.groupby import RambaGroupby  # noqa: F401
 from ramba_tpu.fileio import Dataset, load, register_loader, save  # noqa: F401
 from ramba_tpu import checkpoint  # noqa: F401
@@ -201,6 +202,22 @@ def _register_numpy_dispatch():
         np_fn = getattr(_np, n, None)
         ours = getattr(_self, n, None)
         if np_fn is not None and ours is not None:
+            HANDLED_FUNCTIONS[np_fn] = ours
+
+    # np.linalg.<fn>(ramba_array) routes to ramba_tpu.linalg (beyond the
+    # reference, which exposes no linalg namespace)
+    import inspect as _inspect
+
+    for n in dir(linalg):
+        if n.startswith("_"):
+            continue
+        ours = getattr(linalg, n, None)
+        # only functions defined by our module (not LinAlgError / re-exports)
+        if not _inspect.isfunction(ours) or \
+                getattr(ours, "__module__", "") != "ramba_tpu.linalg":
+            continue
+        np_fn = getattr(_np.linalg, n, None)
+        if callable(np_fn):
             HANDLED_FUNCTIONS[np_fn] = ours
 
 

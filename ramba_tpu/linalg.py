@@ -1,0 +1,151 @@
+"""``ramba_tpu.linalg`` — the numpy.linalg namespace over distributed arrays.
+
+The reference exposes no linalg submodule (matmul/dot only); this goes
+beyond it because drop-in NumPy users reach for ``np.linalg.norm`` et al.
+Static-shape decompositions lower lazily through ``jax.numpy.linalg`` (so
+they fuse into the surrounding flush and run on device); the general
+nonsymmetric eigenproblem is CPU-only in XLA, so ``eig``/``eigvals`` take
+the host boundary like unique/nonzero (ops/extras.py docstring).
+"""
+
+from __future__ import annotations
+
+from collections import namedtuple
+
+import numpy as np
+
+from ramba_tpu.ops.extras import _host, _lazy, _lazy_idx
+
+# numpy 2.x result types (attribute access parity: np.linalg.svd(...).S)
+SVDResult = namedtuple("SVDResult", ["U", "S", "Vh"])
+QRResult = namedtuple("QRResult", ["Q", "R"])
+SlogdetResult = namedtuple("SlogdetResult", ["sign", "logabsdet"])
+EighResult = namedtuple("EighResult", ["eigenvalues", "eigenvectors"])
+
+# Multi-output decompositions below build one lazy node per output; inside
+# a single flush XLA CSE merges the duplicate factorization calls, but
+# outputs materialized in SEPARATE flushes each recompute it — materialize
+# together (or sync() once) when that matters.
+
+
+def norm(x, ord=None, axis=None, keepdims=False):
+    kw = {"keepdims": bool(keepdims)}
+    if ord is not None:
+        kw["ord"] = ord
+    if axis is not None:
+        kw["axis"] = axis if isinstance(axis, int) else tuple(axis)
+    return _lazy("linalg.norm", x, **kw)
+
+
+def det(a):
+    return _lazy("linalg.det", a)
+
+
+def slogdet(a):
+    return SlogdetResult(_lazy_idx("linalg.slogdet", 0, a),
+                         _lazy_idx("linalg.slogdet", 1, a))
+
+
+def inv(a):
+    return _lazy("linalg.inv", a)
+
+
+def pinv(a, rcond=None, hermitian=False, *, rtol=None):
+    kw = {"hermitian": bool(hermitian)}
+    if rtol is not None:
+        kw["rtol"] = float(rtol)
+    elif rcond is not None:
+        kw["rcond"] = float(rcond)
+    return _lazy("linalg.pinv", a, **kw)
+
+
+def solve(a, b):
+    return _lazy("linalg.solve", a, b)
+
+
+def cholesky(a, *, upper=False):
+    return _lazy("linalg.cholesky", a, upper=bool(upper))
+
+
+def qr(a, mode="reduced"):
+    if mode == "r":
+        return _lazy("linalg.qr", a, mode="r")
+    return QRResult(_lazy_idx("linalg.qr", 0, a, mode=mode),
+                    _lazy_idx("linalg.qr", 1, a, mode=mode))
+
+
+def svd(a, full_matrices=True, compute_uv=True, hermitian=False):
+    kw = {"full_matrices": bool(full_matrices),
+          "hermitian": bool(hermitian)}
+    if not compute_uv:
+        return _lazy("linalg.svd", a, compute_uv=False, **kw)
+    return SVDResult(*(_lazy_idx("linalg.svd", i, a, **kw)
+                       for i in range(3)))
+
+
+def svdvals(a):
+    return svd(a, compute_uv=False)
+
+
+def eigh(a, UPLO=None):
+    kw = {} if UPLO is None else {"UPLO": UPLO}
+    return EighResult(_lazy_idx("linalg.eigh", 0, a, **kw),
+                      _lazy_idx("linalg.eigh", 1, a, **kw))
+
+
+def eigvalsh(a, UPLO="L"):
+    return _lazy("linalg.eigvalsh", a, UPLO=UPLO)
+
+
+def matrix_power(a, n):
+    return _lazy("linalg.matrix_power", a, n=int(n))
+
+
+def matrix_rank(a, tol=None, *, rtol=None):
+    # numpy's positional `tol` is an ABSOLUTE cutoff; jax's rtol is
+    # relative — forward each to its own jax keyword, never conflate
+    kw = {}
+    if tol is not None:
+        kw["tol"] = float(tol)
+    if rtol is not None:
+        kw["rtol"] = float(rtol)
+    return _lazy("linalg.matrix_rank", a, **kw)
+
+
+def cond(x, p=None):
+    return _lazy("linalg.cond", x, **({} if p is None else {"p": p}))
+
+
+def lstsq(a, b, rcond=None):
+    outs = tuple(
+        _lazy_idx("linalg.lstsq", i, a, b,
+                  **({} if rcond is None else {"rcond": float(rcond)}))
+        for i in range(4)
+    )
+    return outs
+
+
+def matrix_transpose(x):
+    return _lazy("linalg.matrix_transpose", x)
+
+
+# -- host boundary: XLA has no nonsymmetric eig on accelerators --------------
+
+
+def eig(a):
+    return np.linalg.eig(_host(a))
+
+
+def eigvals(a):
+    return np.linalg.eigvals(_host(a))
+
+
+def tensorsolve(a, b, axes=None):
+    return np.linalg.tensorsolve(_host(a), _host(b), axes=axes)
+
+
+def tensorinv(a, ind=2):
+    return np.linalg.tensorinv(_host(a), ind=ind)
+
+
+LinAlgError = np.linalg.LinAlgError

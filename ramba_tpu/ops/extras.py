@@ -22,10 +22,18 @@ from ramba_tpu.core.ndarray import ndarray, as_exprable
 from ramba_tpu.ops.creation import asarray
 
 
+def _resolve(fname):
+    """Resolve a possibly dotted name ("linalg.norm") inside jax.numpy."""
+    obj = jnp
+    for part in fname.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
 @defop("jnp_call")
 def _op_jnp_call(static, *args):
     fname, kw = static
-    return getattr(jnp, fname)(*args, **dict(kw))
+    return _resolve(fname)(*args, **dict(kw))
 
 
 def _lazy(fname, *arrays, **kwargs):
@@ -245,7 +253,7 @@ def divmod(a, b):  # noqa: A001 - numpy name
 @defop("jnp_call_idx")
 def _op_jnp_call_idx(static, *args):
     fname, idx, kw = static
-    return getattr(jnp, fname)(*args, **dict(kw))[idx]
+    return _resolve(fname)(*args, **dict(kw))[idx]
 
 
 def _lazy_idx(fname, idx, *arrays, **kwargs):

@@ -1,0 +1,155 @@
+"""Differential tests for ramba_tpu.linalg (beyond the reference, which
+exposes no linalg namespace): device-lowered decompositions vs numpy, and
+the host-boundary eig family."""
+
+import numpy as np
+import pytest
+
+import ramba_tpu as rt
+from tests.helpers import default_atol, default_rtol
+
+
+def _cmp(got, want, rtol=1e-8):
+    np.testing.assert_allclose(
+        np.asarray(got), want, rtol=default_rtol(rtol), atol=default_atol()
+    )
+
+
+@pytest.fixture
+def spd():
+    rng = np.random.RandomState(0)
+    m = rng.rand(6, 6)
+    return m @ m.T + 6 * np.eye(6)
+
+
+@pytest.fixture
+def rect():
+    return np.random.RandomState(1).rand(8, 5)
+
+
+class TestDeviceLowered:
+    def test_norm(self, rect):
+        a = rt.fromarray(rect)
+        _cmp(rt.linalg.norm(a), np.linalg.norm(rect))
+        _cmp(rt.linalg.norm(a, axis=1), np.linalg.norm(rect, axis=1))
+        _cmp(rt.linalg.norm(a, ord=1), np.linalg.norm(rect, ord=1), rtol=1e-6)
+        v = rt.fromarray(rect[:, 0])
+        _cmp(rt.linalg.norm(v, ord=np.inf),
+             np.linalg.norm(rect[:, 0], ord=np.inf))
+
+    def test_det_slogdet_inv_solve(self, spd):
+        a = rt.fromarray(spd)
+        _cmp(rt.linalg.det(a), np.linalg.det(spd), rtol=1e-6)
+        gs, gl = rt.linalg.slogdet(a)
+        ws, wl = np.linalg.slogdet(spd)
+        _cmp(gs, ws)
+        _cmp(gl, wl, rtol=1e-6)
+        _cmp(rt.linalg.inv(a), np.linalg.inv(spd), rtol=1e-6)
+        b = np.random.RandomState(2).rand(6)
+        _cmp(rt.linalg.solve(a, rt.fromarray(b)), np.linalg.solve(spd, b),
+             rtol=1e-6)
+
+    def test_cholesky_eigh(self, spd):
+        a = rt.fromarray(spd)
+        _cmp(rt.linalg.cholesky(a), np.linalg.cholesky(spd), rtol=1e-6)
+        gw, gv = rt.linalg.eigh(a)
+        ww, wv = np.linalg.eigh(spd)
+        _cmp(gw, ww, rtol=1e-6)
+        # eigenvectors are sign-ambiguous: compare reconstructions
+        _cmp(np.asarray(gv) @ np.diag(np.asarray(gw)) @ np.asarray(gv).T,
+             spd, rtol=1e-5)
+        _cmp(rt.linalg.eigvalsh(a), np.linalg.eigvalsh(spd), rtol=1e-6)
+
+    def test_qr_svd(self, rect):
+        a = rt.fromarray(rect)
+        q, r = rt.linalg.qr(a)
+        _cmp(np.asarray(q) @ np.asarray(r), rect, rtol=1e-6)
+        u, s, vt = rt.linalg.svd(a, full_matrices=False)
+        _cmp(np.asarray(u) * np.asarray(s) @ np.asarray(vt), rect, rtol=1e-5)
+        _cmp(rt.linalg.svd(a, compute_uv=False),
+             np.linalg.svd(rect, compute_uv=False), rtol=1e-6)
+
+    def test_rank_power_pinv_cond(self, spd, rect):
+        assert int(rt.linalg.matrix_rank(rt.fromarray(spd))) == 6
+        _cmp(rt.linalg.matrix_power(rt.fromarray(spd), 3),
+             np.linalg.matrix_power(spd, 3), rtol=1e-6)
+        _cmp(rt.linalg.pinv(rt.fromarray(rect)), np.linalg.pinv(rect),
+             rtol=1e-5)
+        _cmp(rt.linalg.cond(rt.fromarray(spd)), np.linalg.cond(spd),
+             rtol=1e-5)
+
+    def test_lstsq(self, rect):
+        b = np.random.RandomState(3).rand(8)
+        gx = rt.linalg.lstsq(rt.fromarray(rect), rt.fromarray(b))[0]
+        wx = np.linalg.lstsq(rect, b, rcond=None)[0]
+        _cmp(gx, wx, rtol=1e-5)
+
+    def test_fuses_with_surrounding_ops(self, spd):
+        from ramba_tpu.core import fuser
+
+        a = rt.fromarray(spd)
+        rt.sync()
+        f0 = fuser.stats["flushes"]
+        out = rt.linalg.norm(a * 2.0) + 1.0
+        val = float(out)
+        assert fuser.stats["flushes"] == f0 + 1
+        np.testing.assert_allclose(val, np.linalg.norm(spd * 2) + 1,
+                                   rtol=default_rtol(1e-8))
+
+
+class TestHostBoundary:
+    def test_eig(self, spd):
+        w, v = rt.linalg.eig(rt.fromarray(spd))
+        np.testing.assert_allclose(sorted(w.real),
+                                   sorted(np.linalg.eigvals(spd).real),
+                                   rtol=default_rtol(1e-8))
+        np.testing.assert_allclose(
+            sorted(rt.linalg.eigvals(rt.fromarray(spd)).real),
+            sorted(np.linalg.eigvals(spd).real), rtol=default_rtol(1e-8))
+
+
+class TestNumpyDispatch:
+    def test_np_linalg_routes_here(self, spd):
+        a = rt.fromarray(spd)
+        got = np.linalg.norm(a)
+        _cmp(got, np.linalg.norm(spd))
+        _cmp(np.linalg.det(a), np.linalg.det(spd), rtol=1e-6)
+        _cmp(np.linalg.inv(a), np.linalg.inv(spd), rtol=1e-6)
+
+
+class TestReviewRegressions:
+    def test_result_namedtuples(self, rect, spd):
+        # numpy 2.x attribute access: .U/.S/.Vh, .Q/.R, .sign/.logabsdet,
+        # .eigenvalues/.eigenvectors
+        r = rt.linalg.svd(rt.fromarray(rect), full_matrices=False)
+        _cmp(np.asarray(r.U) * np.asarray(r.S) @ np.asarray(r.Vh), rect,
+             rtol=1e-5)
+        qr = rt.linalg.qr(rt.fromarray(rect))
+        _cmp(np.asarray(qr.Q) @ np.asarray(qr.R), rect, rtol=1e-6)
+        sl = rt.linalg.slogdet(rt.fromarray(spd))
+        _cmp(sl.sign, 1.0)
+        eh = rt.linalg.eigh(rt.fromarray(spd))
+        _cmp(eh.eigenvalues, np.linalg.eigh(spd).eigenvalues, rtol=1e-6)
+
+    def test_numpy_kwargs_forward(self, spd, rect):
+        # numpy-signature keywords must not TypeError through the dispatch
+        _cmp(np.linalg.pinv(rt.fromarray(rect), rcond=1e-10),
+             np.linalg.pinv(rect, rcond=1e-10), rtol=1e-5)
+        _cmp(np.linalg.eigvalsh(rt.fromarray(spd), UPLO="U"),
+             np.linalg.eigvalsh(spd, UPLO="U"), rtol=1e-6)
+        _cmp(np.linalg.cholesky(rt.fromarray(spd), upper=True),
+             np.linalg.cholesky(spd, upper=True), rtol=1e-6)
+
+    def test_matrix_rank_tol_is_absolute(self):
+        # numpy positional tol is an ABSOLUTE cutoff; must not be
+        # reinterpreted as jax's relative rtol
+        d = np.diag([1.0, 0.5, 1e-4])
+        a = rt.fromarray(d)
+        assert int(rt.linalg.matrix_rank(a, 1e-3)) == \
+            int(np.linalg.matrix_rank(d, 1e-3)) == 2
+        assert int(rt.linalg.matrix_rank(a)) == 3
+
+    def test_no_spurious_dispatch_entries(self):
+        from ramba_tpu.core.interop import HANDLED_FUNCTIONS
+
+        assert np.linalg.LinAlgError not in HANDLED_FUNCTIONS
