@@ -80,6 +80,7 @@ from ramba_tpu.skeletons import (  # noqa: F401
     smap_index, spmd, sreduce, sreduce_index, sstencil, sstencil_iterate,
     stencil, worker_id,
 )
+from ramba_tpu import fft  # noqa: F401
 from ramba_tpu import linalg  # noqa: F401
 from ramba_tpu.groupby import RambaGroupby  # noqa: F401
 from ramba_tpu.fileio import Dataset, load, register_loader, save  # noqa: F401
@@ -204,21 +205,23 @@ def _register_numpy_dispatch():
         if np_fn is not None and ours is not None:
             HANDLED_FUNCTIONS[np_fn] = ours
 
-    # np.linalg.<fn>(ramba_array) routes to ramba_tpu.linalg (beyond the
-    # reference, which exposes no linalg namespace)
+    # np.linalg.<fn> / np.fft.<fn> over ramba arrays route to our
+    # submodules (beyond the reference, which exposes neither namespace)
     import inspect as _inspect
 
-    for n in dir(linalg):
-        if n.startswith("_"):
-            continue
-        ours = getattr(linalg, n, None)
-        # only functions defined by our module (not LinAlgError / re-exports)
-        if not _inspect.isfunction(ours) or \
-                getattr(ours, "__module__", "") != "ramba_tpu.linalg":
-            continue
-        np_fn = getattr(_np.linalg, n, None)
-        if callable(np_fn):
-            HANDLED_FUNCTIONS[np_fn] = ours
+    for sub, np_sub in ((linalg, _np.linalg), (fft, _np.fft)):
+        for n in dir(sub):
+            if n.startswith("_"):
+                continue
+            ours = getattr(sub, n, None)
+            # only functions defined by the module itself (no re-exports,
+            # no exception classes)
+            if not _inspect.isfunction(ours) or \
+                    getattr(ours, "__module__", "") != sub.__name__:
+                continue
+            np_fn = getattr(np_sub, n, None)
+            if callable(np_fn):
+                HANDLED_FUNCTIONS[np_fn] = ours
 
 
 _register_numpy_dispatch()

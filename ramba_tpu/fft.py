@@ -1,0 +1,121 @@
+"""``ramba_tpu.fft`` — the numpy.fft namespace over distributed arrays.
+
+Like ``ramba_tpu.linalg``, this goes beyond the reference (which exposes
+no fft submodule): every transform lowers lazily through ``jax.numpy.fft``
+so it fuses into the surrounding flush and runs on device.  Frequency
+helpers (fftfreq/rfftfreq) are creation ops; fftshift/ifftshift are lazy
+index shuffles.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ramba_tpu.ops.extras import _lazy
+
+
+def _fft1(name, a, n=None, axis=-1, norm=None):
+    kw = {"axis": int(axis)}
+    if n is not None:
+        kw["n"] = int(n)
+    if norm is not None:
+        kw["norm"] = norm
+    return _lazy(f"fft.{name}", a, **kw)
+
+
+def fft(a, n=None, axis=-1, norm=None):
+    return _fft1("fft", a, n, axis, norm)
+
+
+def ifft(a, n=None, axis=-1, norm=None):
+    return _fft1("ifft", a, n, axis, norm)
+
+
+def rfft(a, n=None, axis=-1, norm=None):
+    return _fft1("rfft", a, n, axis, norm)
+
+
+def irfft(a, n=None, axis=-1, norm=None):
+    return _fft1("irfft", a, n, axis, norm)
+
+
+def hfft(a, n=None, axis=-1, norm=None):
+    return _fft1("hfft", a, n, axis, norm)
+
+
+def ihfft(a, n=None, axis=-1, norm=None):
+    return _fft1("ihfft", a, n, axis, norm)
+
+
+def _fftn(name, a, s=None, axes=None, norm=None):
+    kw = {}
+    if s is not None:
+        kw["s"] = tuple(int(x) for x in s)
+    if axes is not None:
+        kw["axes"] = tuple(int(x) for x in axes)
+    if norm is not None:
+        kw["norm"] = norm
+    return _lazy(f"fft.{name}", a, **kw)
+
+
+def fft2(a, s=None, axes=(-2, -1), norm=None):
+    return _fftn("fft2", a, s, axes, norm)
+
+
+def ifft2(a, s=None, axes=(-2, -1), norm=None):
+    return _fftn("ifft2", a, s, axes, norm)
+
+
+def rfft2(a, s=None, axes=(-2, -1), norm=None):
+    return _fftn("rfft2", a, s, axes, norm)
+
+
+def irfft2(a, s=None, axes=(-2, -1), norm=None):
+    return _fftn("irfft2", a, s, axes, norm)
+
+
+def fftn(a, s=None, axes=None, norm=None):
+    return _fftn("fftn", a, s, axes, norm)
+
+
+def ifftn(a, s=None, axes=None, norm=None):
+    return _fftn("ifftn", a, s, axes, norm)
+
+
+def rfftn(a, s=None, axes=None, norm=None):
+    return _fftn("rfftn", a, s, axes, norm)
+
+
+def irfftn(a, s=None, axes=None, norm=None):
+    return _fftn("irfftn", a, s, axes, norm)
+
+
+def _axes_kw(axes):
+    import operator
+
+    if axes is None:
+        return {}
+    try:
+        return {"axes": operator.index(axes)}  # accepts numpy int scalars
+    except TypeError:
+        return {"axes": tuple(operator.index(d) for d in axes)}
+
+
+def fftshift(x, axes=None):
+    return _lazy("fft.fftshift", x, **_axes_kw(axes))
+
+
+def ifftshift(x, axes=None):
+    return _lazy("fft.ifftshift", x, **_axes_kw(axes))
+
+
+def fftfreq(n, d=1.0):
+    from ramba_tpu.ops.creation import fromarray
+
+    return fromarray(np.fft.fftfreq(int(n), d=float(d)))
+
+
+def rfftfreq(n, d=1.0):
+    from ramba_tpu.ops.creation import fromarray
+
+    return fromarray(np.fft.rfftfreq(int(n), d=float(d)))

@@ -29,11 +29,16 @@ EighResult = namedtuple("EighResult", ["eigenvalues", "eigenvectors"])
 
 
 def norm(x, ord=None, axis=None, keepdims=False):
+    import operator
+
     kw = {"keepdims": bool(keepdims)}
     if ord is not None:
         kw["ord"] = ord
     if axis is not None:
-        kw["axis"] = axis if isinstance(axis, int) else tuple(axis)
+        try:
+            kw["axis"] = operator.index(axis)  # accepts numpy int scalars
+        except TypeError:
+            kw["axis"] = tuple(operator.index(d) for d in axis)
     return _lazy("linalg.norm", x, **kw)
 
 
@@ -102,13 +107,14 @@ def matrix_power(a, n):
 
 
 def matrix_rank(a, tol=None, *, rtol=None):
-    # numpy's positional `tol` is an ABSOLUTE cutoff; jax's rtol is
-    # relative — forward each to its own jax keyword, never conflate
-    kw = {}
+    # numpy's positional `tol` is an ABSOLUTE cutoff on singular values.
+    # jax's matrix_rank has no absolute mode (its `tol` keyword is an
+    # alias of the relative rtol), so build the absolute form from the
+    # singular values directly: rank = #{s_i > tol}.
     if tol is not None:
-        kw["tol"] = float(tol)
-    if rtol is not None:
-        kw["rtol"] = float(rtol)
+        s = svd(a, compute_uv=False)
+        return (s > float(tol)).sum()
+    kw = {} if rtol is None else {"rtol": float(rtol)}
     return _lazy("linalg.matrix_rank", a, **kw)
 
 
@@ -117,12 +123,11 @@ def cond(x, p=None):
 
 
 def lstsq(a, b, rcond=None):
-    outs = tuple(
-        _lazy_idx("linalg.lstsq", i, a, b,
-                  **({} if rcond is None else {"rcond": float(rcond)}))
-        for i in range(4)
-    )
-    return outs
+    # numpy's residual semantics (empty array for underdetermined or
+    # rank-deficient systems, Python-int rank) branch on data-dependent
+    # values, which cannot trace — host boundary like eig (this function
+    # is the np.linalg.lstsq dispatch target, so parity matters)
+    return np.linalg.lstsq(_host(a), _host(b), rcond=rcond)
 
 
 def matrix_transpose(x):

@@ -1,0 +1,79 @@
+"""Differential tests for ramba_tpu.fft (beyond the reference, which
+exposes no fft submodule)."""
+
+import numpy as np
+import pytest
+
+import ramba_tpu as rt
+from tests.helpers import default_atol, default_rtol
+
+
+def _cmp(got, want, rtol=1e-8):
+    np.testing.assert_allclose(
+        np.asarray(got), want, rtol=default_rtol(rtol), atol=default_atol()
+    )
+
+
+@pytest.fixture
+def sig():
+    return np.random.RandomState(0).rand(128)
+
+
+@pytest.fixture
+def img():
+    return np.random.RandomState(1).rand(16, 32)
+
+
+class TestTransforms:
+    def test_fft_roundtrip(self, sig):
+        a = rt.fromarray(sig)
+        f = rt.fft.fft(a)
+        _cmp(f, np.fft.fft(sig), rtol=1e-6)
+        back = rt.fft.ifft(f)
+        _cmp(np.asarray(back).real, sig, rtol=1e-5)
+
+    def test_rfft_family(self, sig):
+        a = rt.fromarray(sig)
+        _cmp(rt.fft.rfft(a), np.fft.rfft(sig), rtol=1e-6)
+        _cmp(rt.fft.irfft(rt.fft.rfft(a)), sig, rtol=1e-5)
+        _cmp(rt.fft.ihfft(a), np.fft.ihfft(sig), rtol=1e-6)
+
+    def test_fft_args(self, sig):
+        a = rt.fromarray(sig)
+        _cmp(rt.fft.fft(a, n=64), np.fft.fft(sig, n=64), rtol=1e-6)
+        _cmp(rt.fft.fft(a, norm="ortho"), np.fft.fft(sig, norm="ortho"),
+             rtol=1e-6)
+
+    def test_2d_and_nd(self, img):
+        a = rt.fromarray(img)
+        _cmp(rt.fft.fft2(a), np.fft.fft2(img), rtol=1e-6)
+        _cmp(rt.fft.rfft2(a), np.fft.rfft2(img), rtol=1e-6)
+        _cmp(rt.fft.fftn(a, axes=(0,)), np.fft.fftn(img, axes=(0,)),
+             rtol=1e-6)
+        _cmp(np.asarray(rt.fft.ifftn(rt.fft.fftn(a))).real, img, rtol=1e-5)
+
+    def test_shift_freq(self, sig):
+        a = rt.fromarray(sig)
+        _cmp(rt.fft.fftshift(a), np.fft.fftshift(sig))
+        _cmp(rt.fft.ifftshift(rt.fft.fftshift(a)), sig)
+        _cmp(rt.fft.fftfreq(64, d=0.5), np.fft.fftfreq(64, d=0.5))
+        _cmp(rt.fft.rfftfreq(64), np.fft.rfftfreq(64))
+
+    def test_np_dispatch(self, sig):
+        a = rt.fromarray(sig)
+        got = np.fft.rfft(a)
+        assert isinstance(got, type(a))
+        _cmp(got, np.fft.rfft(sig), rtol=1e-6)
+
+    def test_fuses_with_elementwise(self, sig):
+        from ramba_tpu.core import fuser
+
+        a = rt.fromarray(sig)
+        rt.sync()
+        f0 = fuser.stats["flushes"]
+        power = rt.abs(rt.fft.rfft(a * 2.0)) ** 2
+        total = float(rt.sum(power))
+        assert fuser.stats["flushes"] == f0 + 1
+        np.testing.assert_allclose(
+            total, (np.abs(np.fft.rfft(sig * 2)) ** 2).sum(),
+            rtol=default_rtol(1e-6))

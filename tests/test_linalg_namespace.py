@@ -142,12 +142,34 @@ class TestReviewRegressions:
 
     def test_matrix_rank_tol_is_absolute(self):
         # numpy positional tol is an ABSOLUTE cutoff; must not be
-        # reinterpreted as jax's relative rtol
-        d = np.diag([1.0, 0.5, 1e-4])
+        # reinterpreted as jax's relative rtol.  Largest singular value is
+        # 100, so absolute (rank 3) and relative (rank 1) disagree here —
+        # review r4 found the earlier test masked the conflation at
+        # s_max == 1.
+        d = np.diag([100.0, 0.05, 0.04])
         a = rt.fromarray(d)
         assert int(rt.linalg.matrix_rank(a, 1e-3)) == \
-            int(np.linalg.matrix_rank(d, 1e-3)) == 2
+            int(np.linalg.matrix_rank(d, 1e-3)) == 3
+        d2 = np.diag([1.0, 0.5, 1e-4])
+        assert int(rt.linalg.matrix_rank(rt.fromarray(d2), 1e-3)) == 2
         assert int(rt.linalg.matrix_rank(a)) == 3
+
+    def test_lstsq_numpy_residual_semantics(self):
+        # underdetermined system: numpy's residuals output is empty
+        a = np.random.RandomState(4).rand(3, 5)
+        b = np.random.RandomState(5).rand(3)
+        g = rt.linalg.lstsq(rt.fromarray(a), rt.fromarray(b))
+        w = np.linalg.lstsq(a, b, rcond=None)
+        assert np.asarray(g[1]).size == w[1].size == 0
+        _cmp(g[0], w[0], rtol=1e-5)
+
+    def test_axis_accepts_numpy_ints(self):
+        m = np.random.RandomState(6).rand(4, 5)
+        _cmp(rt.linalg.norm(rt.fromarray(m), axis=np.int64(1)),
+             np.linalg.norm(m, axis=np.int64(1)))
+        v = np.arange(8.0)
+        _cmp(rt.fft.fftshift(rt.fromarray(v), axes=np.int64(0)),
+             np.fft.fftshift(v, axes=np.int64(0)))
 
     def test_no_spurious_dispatch_entries(self):
         from ramba_tpu.core.interop import HANDLED_FUNCTIONS
