@@ -691,8 +691,33 @@ def _op_random(static, key, *params):
         lo, hi = params
         x = jax.random.uniform(key, shape, dtype=dt, minval=lo, maxval=hi)
     elif kind == "permutation":
-        (n,) = params
-        x = jax.random.permutation(key, n)
+        # n is static (the node's output shape)
+        x = jax.random.permutation(key, shape[0])
+    elif kind == "permutation_array":
+        (arr,) = params
+        x = jax.random.permutation(key, arr)
+    elif kind == "exponential":
+        x = jax.random.exponential(key, shape, dtype=dt)
+    elif kind == "poisson":
+        (lam,) = params
+        x = jax.random.poisson(key, lam, shape).astype(dt)
+    elif kind == "beta":
+        a, b = params
+        x = jax.random.beta(key, a, b, shape, dtype=dt)
+    elif kind == "gamma":
+        (a,) = params
+        x = jax.random.gamma(key, a, shape, dtype=dt)
+    elif kind == "binomial":
+        n, pr = params
+        x = jax.random.binomial(key, n, pr, shape).astype(dt)
+    elif kind in ("choice", "choice_norepl"):
+        replace = kind == "choice"
+        if len(params) == 2:
+            arr, p = params
+            x = jax.random.choice(key, arr, shape, replace=replace, p=p)
+        else:
+            (arr,) = params
+            x = jax.random.choice(key, arr, shape, replace=replace)
     else:
         raise ValueError(kind)
     return _constrain(x, spec)

@@ -91,14 +91,9 @@ def irfftn(a, s=None, axes=None, norm=None):
 
 
 def _axes_kw(axes):
-    import operator
+    from ramba_tpu.ops.extras import _axis_arg
 
-    if axes is None:
-        return {}
-    try:
-        return {"axes": operator.index(axes)}  # accepts numpy int scalars
-    except TypeError:
-        return {"axes": tuple(operator.index(d) for d in axes)}
+    return {} if axes is None else {"axes": _axis_arg(axes)}
 
 
 def fftshift(x, axes=None):

@@ -14,7 +14,7 @@ from collections import namedtuple
 
 import numpy as np
 
-from ramba_tpu.ops.extras import _host, _lazy, _lazy_idx
+from ramba_tpu.ops.extras import _axis_arg, _host, _lazy, _lazy_idx
 
 # numpy 2.x result types (attribute access parity: np.linalg.svd(...).S)
 SVDResult = namedtuple("SVDResult", ["U", "S", "Vh"])
@@ -29,16 +29,11 @@ EighResult = namedtuple("EighResult", ["eigenvalues", "eigenvectors"])
 
 
 def norm(x, ord=None, axis=None, keepdims=False):
-    import operator
-
     kw = {"keepdims": bool(keepdims)}
     if ord is not None:
         kw["ord"] = ord
     if axis is not None:
-        try:
-            kw["axis"] = operator.index(axis)  # accepts numpy int scalars
-        except TypeError:
-            kw["axis"] = tuple(operator.index(d) for d in axis)
+        kw["axis"] = _axis_arg(axis)
     return _lazy("linalg.norm", x, **kw)
 
 
@@ -112,8 +107,15 @@ def matrix_rank(a, tol=None, *, rtol=None):
     # alias of the relative rtol), so build the absolute form from the
     # singular values directly: rank = #{s_i > tol}.
     if tol is not None:
+        from ramba_tpu.ops.creation import asarray as _asarray
+
+        a = _asarray(a)
+        if a.ndim < 2:
+            # numpy: a 1-D input has rank 1 iff any |x| exceeds tol
+            return (abs(a) > float(tol)).any().astype(int)
         s = svd(a, compute_uv=False)
-        return (s > float(tol)).sum()
+        # count per matrix (last axis) so stacked inputs keep their batch
+        return (s > float(tol)).sum(axis=-1)
     kw = {} if rtol is None else {"rtol": float(rtol)}
     return _lazy("linalg.matrix_rank", a, **kw)
 

@@ -47,6 +47,18 @@ def _host(x):
     return x.asarray() if isinstance(x, ndarray) else np.asarray(x)
 
 
+def _axis_arg(axis):
+    """Normalize an int-or-tuple axis argument, accepting numpy integer
+    scalars (operator.index) — shared by linalg.norm / fft shifts / any
+    future int-or-tuple axis signature."""
+    import operator
+
+    try:
+        return operator.index(axis)
+    except TypeError:
+        return tuple(operator.index(d) for d in axis)
+
+
 # -- static-shape, lazily fused ----------------------------------------------
 
 

@@ -121,3 +121,81 @@ class RandomState:
 
 def default_rng(s=None):
     return RandomState(s)
+
+
+# -- round-4 breadth: the rest of the numpy.random surface -------------------
+# (beyond the reference module, which stops at random/normal/randint/
+# uniform/randn; all device-count-invariant via the same threefry stream)
+
+
+def standard_normal(size=None):
+    return _rand("normal", size, jnp.zeros(0).dtype)
+
+
+def exponential(scale=1.0, size=None):
+    out = _rand("exponential", size, jnp.zeros(0).dtype)
+    return out * scale if scale != 1.0 else out
+
+
+def poisson(lam=1.0, size=None):
+    return _rand("poisson", size, jnp.dtype(int), (float(lam),))
+
+
+def beta(a, b, size=None):
+    return _rand("beta", size, jnp.zeros(0).dtype, (float(a), float(b)))
+
+
+def gamma(shape, scale=1.0, size=None):
+    out = _rand("gamma", size, jnp.zeros(0).dtype, (float(shape),))
+    return out * scale if scale != 1.0 else out
+
+
+def binomial(n, p, size=None):
+    return _rand("binomial", size, jnp.dtype(int), (int(n), float(p)))
+
+
+def permutation(x):
+    """numpy.random.permutation: permuted range for an int, a shuffled
+    copy (along axis 0) for an array."""
+    from ramba_tpu.ops.creation import asarray as _asarray
+    from ramba_tpu.core.ndarray import as_exprable
+
+    if isinstance(x, (int, np.integer)):
+        n = int(x)
+        spec = tuple(_mesh.default_spec((n,)))
+        return ndarray(
+            Node("random", ("permutation", (n,), "int32", spec),
+                 [Const(_next_key())])
+        )
+    a = _asarray(x)
+    spec = tuple(_mesh.default_spec(a.shape))
+    return ndarray(
+        Node("random", ("permutation_array", tuple(a.shape),
+                        str(np.dtype(a.dtype)), spec),
+             [Const(_next_key()), as_exprable(a)])
+    )
+
+
+def shuffle(x):
+    """numpy.random.shuffle: permute the array along axis 0 IN PLACE
+    (write-back through the functional machinery)."""
+    x[...] = permutation(x)
+
+
+def choice(a, size=None, replace=True, p=None):
+    from ramba_tpu.ops.creation import asarray as _asarray
+    from ramba_tpu.core.ndarray import as_exprable
+
+    if isinstance(a, (int, np.integer)):
+        a = _asarray(np.arange(int(a)))
+    else:
+        a = _asarray(a)
+    shape = _canon_shape(size)
+    spec = tuple(_mesh.default_spec(shape))
+    kind = "choice" if replace else "choice_norepl"
+    operands = [Const(_next_key()), as_exprable(a)]
+    if p is not None:
+        operands.append(as_exprable(_asarray(np.asarray(p, dtype=float))))
+    return ndarray(
+        Node("random", (kind, shape, str(np.dtype(a.dtype)), spec), operands)
+    )

@@ -175,3 +175,15 @@ class TestReviewRegressions:
         from ramba_tpu.core.interop import HANDLED_FUNCTIONS
 
         assert np.linalg.LinAlgError not in HANDLED_FUNCTIONS
+
+    def test_matrix_rank_batched_and_1d(self):
+        # review r4: absolute-tol rank must count per matrix for stacked
+        # inputs and handle 1-D without SVD
+        A = np.diag([100.0, 0.05, 0.04])
+        B = np.diag([1.0, 1e-5, 1e-6])
+        stacked = np.stack([A, B])
+        got = np.asarray(rt.linalg.matrix_rank(rt.fromarray(stacked), 1e-3))
+        np.testing.assert_array_equal(got, [3, 1])
+        v = np.array([0.0, 2.0, 0.0])
+        assert int(rt.linalg.matrix_rank(rt.fromarray(v), 1e-3)) == 1
+        assert int(rt.linalg.matrix_rank(rt.fromarray(np.zeros(3)), 1e-3)) == 0
