@@ -153,6 +153,59 @@ clongdouble = _np.clongdouble
 iinfo = _np.iinfo
 finfo = _np.finfo
 
+# index/iteration/printing/dtype utilities that operate on host values or
+# pure metadata — numpy's own implementations are exactly right
+s_ = _np.s_
+index_exp = _np.index_exp
+ndindex = _np.ndindex
+broadcast_shapes = _np.broadcast_shapes
+errstate = _np.errstate
+printoptions = _np.printoptions
+set_printoptions = _np.set_printoptions
+get_printoptions = _np.get_printoptions
+promote_types = _np.promote_types
+can_cast = _np.can_cast
+issubdtype = _np.issubdtype
+
+
+def shape(a):
+    # pure metadata: never upload host inputs to device just to read it
+    return a.shape if isinstance(a, ndarray) else _np.shape(a)
+
+
+def ndim(a):
+    return a.ndim if isinstance(a, ndarray) else _np.ndim(a)
+
+
+def size(a, axis=None):
+    if not isinstance(a, ndarray):
+        return _np.size(a, axis)
+    return a.shape[axis] if axis is not None else a.size
+
+
+def ndenumerate(arr):
+    from ramba_tpu.ops.extras import _host
+
+    return _np.ndenumerate(_host(arr))
+
+
+def array2string(a, *args, **kwargs):
+    from ramba_tpu.ops.extras import _host
+
+    return _np.array2string(_host(a), *args, **kwargs)
+
+
+def array_repr(arr, *args, **kwargs):
+    from ramba_tpu.ops.extras import _host
+
+    return _np.array_repr(_host(arr), *args, **kwargs)
+
+
+def array_str(a, *args, **kwargs):
+    from ramba_tpu.ops.extras import _host
+
+    return _np.array_str(_host(a), *args, **kwargs)
+
 
 def init():
     """Explicit cluster bring-up for API parity (the reference initializes
@@ -198,6 +251,7 @@ def _register_numpy_dispatch():
         "insert", "delete", "compress", "extract", "convolve", "correlate",
         "cov", "corrcoef", "modf", "divmod", "nan_to_num", "ediff1d",
         "row_stack",
+        "shape", "ndim", "size", "array2string", "array_repr", "array_str",
     ]
     for n in names:
         np_fn = getattr(_np, n, None)

@@ -344,3 +344,45 @@ class TestRandomBreadth:
         want = map_dtype(np.int64)
         assert np.asarray(rt.random.poisson(3.0, size=8)).dtype == want
         assert np.asarray(rt.random.binomial(5, 0.5, size=8)).dtype == want
+
+
+class TestNamespaceUtilities:
+    def test_index_and_metadata_helpers(self):
+        assert rt.s_[1:5] == np.s_[1:5]
+        assert rt.index_exp[2] == np.index_exp[2]
+        assert list(rt.ndindex(2, 2)) == list(np.ndindex(2, 2))
+        assert rt.broadcast_shapes((3, 1), (4,)) == (3, 4)
+        assert rt.promote_types(np.int32, np.float32) == np.float64
+        assert rt.can_cast(np.int32, np.int64)
+        assert rt.issubdtype(np.float32, np.floating)
+
+    def test_shape_ndim_size(self):
+        a = rt.fromarray(np.zeros((3, 4)))
+        assert rt.shape(a) == (3, 4)
+        assert rt.ndim(a) == 2
+        assert rt.size(a) == 12 and rt.size(a, 1) == 4
+
+    def test_printing_and_iteration(self):
+        a = rt.fromarray(np.arange(4.0))
+        s = rt.array2string(a)
+        assert "0." in s and "3." in s
+        assert "array" in rt.array_repr(a)
+        items = list(rt.ndenumerate(a))
+        assert items[0] == ((0,), 0.0) and items[-1] == ((3,), 3.0)
+        with rt.printoptions(precision=2):
+            assert len(rt.array_str(rt.fromarray(np.array([1.23456])))) < 12
+        with rt.errstate(divide="ignore"):
+            np.float64(1.0) / np.float64(0.0)
+
+    def test_np_metadata_dispatch_and_host_inputs(self):
+        # review r4: np.shape/np.size on ramba arrays must dispatch (not
+        # TypeError), and host inputs must not round-trip through device
+        a = rt.fromarray(np.zeros((3, 4)))
+        assert np.shape(a) == (3, 4)
+        assert np.ndim(a) == 2
+        assert np.size(a) == 12
+        assert "0." in np.array2string(a)
+        # plain host inputs stay host-side (free metadata reads)
+        assert rt.shape([[1, 2], [3, 4]]) == (2, 2)
+        assert rt.ndim(5) == 0
+        assert rt.size(np.zeros((2, 5)), 1) == 5
