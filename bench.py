@@ -326,8 +326,6 @@ def main():
         # surface the chip under its own platform name.
         if platform != "cpu":
             try:
-                import os
-
                 sys.path.insert(
                     0,
                     os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -407,6 +405,37 @@ def main():
             out["bcast_error"] = traceback.format_exc(limit=2)[-300:]
     except Exception:  # noqa: BLE001 - even import/backend failure emits JSON
         out["error"] = traceback.format_exc(limit=3)[-400:]
+
+    # Persist/recall the last successful on-TPU run: the tunneled chip can
+    # be unreachable for hours (round-4 postmortem: a killed client wedged
+    # the relay lease), so a CPU-fallback OR total-failure line also
+    # carries the most recent real hardware numbers, labeled with their
+    # timestamp.  The file is committed on purpose — the recall is only
+    # useful if it survives a fresh checkout; recorded_at marks staleness.
+    last_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_LAST.json"
+    )
+    try:
+        on_hw = out.get("platform") not in (None, "cpu")
+        any_number = any(
+            out.get(k) is not None
+            for k in ("value", "stencil_mflops", "stencil_iter_mflops",
+                      "axpy_gb_per_s", "bcast_gelems_per_s")
+        )
+        if on_hw and any_number:
+            rec = dict(out)
+            rec["recorded_at"] = time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            )
+            with open(last_path, "w") as f:
+                json.dump(rec, f)
+        elif os.path.exists(last_path):
+            # cpu fallback AND hard failures (platform never set) both
+            # recall the cache
+            with open(last_path) as f:
+                out["last_tpu_result"] = json.load(f)
+    except Exception:  # noqa: BLE001 - never let bookkeeping break the JSON
+        pass
 
     print(json.dumps(out))
     return 0
