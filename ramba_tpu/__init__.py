@@ -31,10 +31,11 @@ from ramba_tpu.core.fuser import flush, sync, stats as fuser_stats  # noqa: F401
 from ramba_tpu.core.masked import MaskedArray  # noqa: F401
 from ramba_tpu.core.ndarray import ndarray  # noqa: F401
 from ramba_tpu.ops.creation import (  # noqa: F401
-    arange, array, asarray, copy, create_array_with_divisions, empty,
-    empty_like, eye, fromarray, fromfunction, full, full_like, identity,
-    indices, init_array, linspace, meshgrid, mgrid, ones, ones_like, tri,
-    zeros, zeros_like,
+    arange, array, asarray, asarray_chkfinite, ascontiguousarray,
+    asfortranarray, copy, create_array_with_divisions, empty, empty_like,
+    eye, frombuffer, fromarray, fromfunction, fromiter, fromstring, full,
+    full_like, geomspace, identity, indices, init_array, linspace, logspace,
+    meshgrid, mgrid, ones, ones_like, rollaxis, tri, zeros, zeros_like,
 )
 from ramba_tpu.core.interop import implements, isscalar, result_type  # noqa: F401
 from ramba_tpu.ops.elementwise import *  # noqa: F401,F403
@@ -83,7 +84,9 @@ from ramba_tpu.skeletons import (  # noqa: F401
 from ramba_tpu import fft  # noqa: F401
 from ramba_tpu import linalg  # noqa: F401
 from ramba_tpu.groupby import RambaGroupby  # noqa: F401
-from ramba_tpu.fileio import Dataset, load, register_loader, save  # noqa: F401
+from ramba_tpu.fileio import (  # noqa: F401
+    Dataset, genfromtxt, load, loadtxt, register_loader, save, savetxt,
+)
 from ramba_tpu import checkpoint  # noqa: F401
 from ramba_tpu import random  # noqa: F401
 from ramba_tpu.parallel import distributed  # noqa: F401
@@ -252,6 +255,8 @@ def _register_numpy_dispatch():
         "cov", "corrcoef", "modf", "divmod", "nan_to_num", "ediff1d",
         "row_stack",
         "shape", "ndim", "size", "array2string", "array_repr", "array_str",
+        "logspace", "geomspace", "ascontiguousarray", "asfortranarray",
+        "rollaxis",
     ]
     for n in names:
         np_fn = getattr(_np, n, None)

@@ -428,3 +428,27 @@ def save(path: str, arr) -> None:
         raise ValueError(
             f"no saver for extension {ext!r} (supported: npy, h5/hdf5)"
         )
+
+
+def loadtxt(fname, dtype=float, comments="#", delimiter=None, skiprows=0,
+            usecols=None, ndmin=0):
+    """numpy.loadtxt → distributed array (host parse, sharded on arrival)."""
+    from ramba_tpu.ops.creation import fromarray
+
+    return fromarray(np.loadtxt(fname, dtype=dtype, comments=comments,
+                                delimiter=delimiter, skiprows=skiprows,
+                                usecols=usecols, ndmin=ndmin))
+
+
+def genfromtxt(fname, **kwargs):
+    from ramba_tpu.ops.creation import fromarray
+
+    return fromarray(np.genfromtxt(fname, **kwargs))
+
+
+def savetxt(fname, X, fmt="%.18e", delimiter=" ", newline="\n", header="",
+            footer="", comments="# "):
+    """numpy.savetxt from a distributed array (gathers to host)."""
+    x = X.asarray() if hasattr(X, "asarray") else np.asarray(X)
+    np.savetxt(fname, x, fmt=fmt, delimiter=delimiter, newline=newline,
+               header=header, footer=footer, comments=comments)

@@ -338,3 +338,93 @@ def indices(dimensions, dtype=int):
 
         outs.append(fromfunction(f, shape, dtype=dtype))
     return stack(outs)
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
+             distribution=None):
+    """numpy.logspace: base**linspace — composes on the lazy linspace so
+    the whole thing fuses (round-4 breadth)."""
+    ls = linspace(float(start), float(stop), num, endpoint=endpoint,
+                  distribution=distribution)
+    out = float(base) ** ls
+    return out.astype(dtype) if dtype is not None else out
+
+
+def geomspace(start, stop, num=50, endpoint=True, dtype=None,
+              distribution=None):
+    """numpy.geomspace: geometric progression via logspace in log-space."""
+    import math
+
+    if start == 0 or stop == 0:
+        raise ValueError("Geometric sequence cannot include zero")
+    if isinstance(start, complex) or isinstance(stop, complex):
+        # complex geometric progressions need log of the complex ratio;
+        # raise explicitly rather than a confusing comparison TypeError
+        raise NotImplementedError(
+            "complex start/stop is not supported; compute on host with "
+            "numpy.geomspace and wrap with fromarray")
+    sgn = 1.0
+    if start < 0 and stop < 0:
+        sgn, start, stop = -1.0, -start, -stop
+    out = sgn * logspace(math.log10(start), math.log10(stop), num,
+                         endpoint=endpoint, distribution=distribution)
+    return out.astype(dtype) if dtype is not None else out
+
+
+def fromiter(iterable, dtype, count=-1):
+    return fromarray(np.fromiter(iterable, dtype=dtype, count=count))
+
+
+def frombuffer(buffer, dtype=float, count=-1, offset=0):
+    return fromarray(
+        np.frombuffer(buffer, dtype=dtype, count=count, offset=offset).copy()
+    )
+
+
+def fromstring(string, dtype=float, sep=" "):
+    return fromarray(np.fromstring(string, dtype=dtype, sep=sep))
+
+
+def ascontiguousarray(a, dtype=None):
+    # shards are always dense/contiguous on device; this is asarray + cast
+    out = asarray(a)
+    return out.astype(dtype) if dtype is not None else out
+
+
+asfortranarray = ascontiguousarray  # layout is XLA's concern, not the user's
+
+
+def asarray_chkfinite(a, dtype=None):
+    out = asarray(a)
+    from ramba_tpu.ops import reductions as _red
+    from ramba_tpu.ops.elementwise import isfinite
+
+    if np.dtype(out.dtype).kind in "fc" and not bool(
+        _red.all(isfinite(out))
+    ):
+        raise ValueError("array must not contain infs or NaNs")
+    return out.astype(dtype) if dtype is not None else out
+
+
+def rollaxis(a, axis, start=0):
+    # numpy.rollaxis (legacy moveaxis): numpy's exact normalization —
+    # negative values get +n (NOT a modulo), out-of-range raises
+    a = asarray(a)
+    n = a.ndim
+    if axis < 0:
+        axis += n
+    if not 0 <= axis < n:
+        raise np.exceptions.AxisError(
+            f"axis {axis} is out of bounds for array of dimension {n}")
+    if start < 0:
+        start += n
+    if not 0 <= start <= n:
+        raise np.exceptions.AxisError(
+            f"start {start} is out of bounds for array of dimension {n}")
+    if axis < start:
+        start -= 1
+    if axis == start:
+        return a
+    from ramba_tpu.ops.manipulation import moveaxis
+
+    return moveaxis(a, axis, start)

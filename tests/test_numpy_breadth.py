@@ -386,3 +386,60 @@ class TestNamespaceUtilities:
         assert rt.shape([[1, 2], [3, 4]]) == (2, 2)
         assert rt.ndim(5) == 0
         assert rt.size(np.zeros((2, 5)), 1) == 5
+
+
+class TestCreationIOBreadth:
+    def test_logspace_geomspace(self):
+        _cmp(rt.logspace(0, 3, 10), np.logspace(0, 3, 10), rtol=1e-6)
+        _cmp(rt.logspace(0, 4, 8, base=2.0), np.logspace(0, 4, 8, base=2.0),
+             rtol=1e-6)
+        _cmp(rt.geomspace(1, 1000, 4), np.geomspace(1, 1000, 4), rtol=1e-6)
+        _cmp(rt.geomspace(-1, -1000, 4), np.geomspace(-1, -1000, 4),
+             rtol=1e-6)
+        with pytest.raises(ValueError):
+            rt.geomspace(0, 10, 5)
+
+    def test_from_variants(self):
+        np.testing.assert_array_equal(
+            np.asarray(rt.fromiter(range(5), int)), np.arange(5))
+        buf = np.arange(4.0).tobytes()
+        _cmp(rt.frombuffer(buf), np.frombuffer(buf))
+        _cmp(rt.fromstring("1 2 3", sep=" "), np.array([1.0, 2.0, 3.0]))
+
+    def test_contiguous_chkfinite_rollaxis(self):
+        a = rt.fromarray(np.arange(6.0))
+        assert rt.ascontiguousarray(a) is not None
+        with pytest.raises(ValueError, match="infs or NaNs"):
+            rt.asarray_chkfinite(np.array([1.0, np.nan]))
+        m = rt.fromarray(np.zeros((2, 3, 4)))
+        assert np.asarray(rt.rollaxis(m, 2)).shape == np.rollaxis(
+            np.zeros((2, 3, 4)), 2).shape
+        assert np.asarray(rt.rollaxis(m, 0, 3)).shape == np.rollaxis(
+            np.zeros((2, 3, 4)), 0, 3).shape
+
+    def test_loadtxt_savetxt(self, tmp_path):
+        p = str(tmp_path / "t.txt")
+        data = np.arange(6.0).reshape(2, 3)
+        rt.savetxt(p, rt.fromarray(data))
+        _cmp(rt.loadtxt(p), data)
+        p2 = str(tmp_path / "t2.txt")
+        np.savetxt(p2, data, delimiter=",")
+        _cmp(rt.loadtxt(p2, delimiter=","), data)
+        _cmp(rt.genfromtxt(p2, delimiter=","), data)
+
+    def test_rollaxis_negative_and_errors(self):
+        # review r4: negative start must add n (not modulo), out-of-range
+        # axis must raise like numpy
+        base = np.zeros((2, 3, 4))
+        m = rt.fromarray(base)
+        for axis in range(-3, 3):
+            for start in range(-3, 4):
+                got = np.asarray(rt.rollaxis(m, axis, start)).shape
+                want = np.rollaxis(base, axis, start).shape
+                assert got == want, (axis, start, got, want)
+        with pytest.raises(Exception, match="out of bounds"):
+            rt.rollaxis(m, 5)
+
+    def test_geomspace_complex_raises_clearly(self):
+        with pytest.raises(NotImplementedError, match="complex"):
+            rt.geomspace(1j, 1000j, 4)
