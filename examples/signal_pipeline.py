@@ -1,0 +1,39 @@
+"""Signal-processing pipeline on the linalg/fft namespaces.
+
+A drop-in NumPy workflow — low-pass filter a noisy signal with the fft
+family, then least-squares fit the recovered waveform — where every
+device-lowerable step fuses into the surrounding flush and runs sharded.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import ramba_tpu as rt
+
+n = 1 << 14
+t = np.linspace(0.0, 1.0, n, endpoint=False)
+rng = np.random.RandomState(0)
+clean = np.sin(2 * np.pi * 5 * t) + 0.5 * np.sin(2 * np.pi * 12 * t)
+noisy = rt.fromarray(clean + 0.8 * rng.randn(n))
+
+# low-pass: zero every frequency bin above 20 Hz (on device, fused)
+spectrum = rt.fft.rfft(noisy)
+freqs = rt.fft.rfftfreq(n, d=t[1] - t[0])
+filtered = rt.fft.irfft(rt.where(freqs <= 20.0, spectrum, 0.0))
+
+clean_d = rt.fromarray(clean)
+err_before = float(rt.mean(rt.abs(noisy - clean_d)))
+err_after = float(rt.mean(rt.abs(filtered - clean_d)))
+print(f"mean abs error: {err_before:.3f} -> {err_after:.3f}")
+
+# recover the two component amplitudes by least squares on the design
+# matrix [sin 5t, sin 12t]
+design = rt.stack(
+    [rt.fromarray(np.sin(2 * np.pi * 5 * t)),
+     rt.fromarray(np.sin(2 * np.pi * 12 * t))], ).T
+coef, *_ = rt.linalg.lstsq(design, filtered)
+print("fitted amplitudes:", np.round(np.asarray(coef), 3), "(true: [1.0 0.5])")
