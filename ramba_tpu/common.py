@@ -48,9 +48,21 @@ big_data = _env_flag("RAMBA_BIG_DATA")
 # (reference: do_not_distribute threshold, /root/reference/ramba/common.py:26,217-218).
 dist_threshold = _env_int("RAMBA_DIST_THRESHOLD", 100)
 
-# Max pending lazy ops before a forced flush (safety valve; the reference DAG is
-# unbounded but practical programs sync often).
+# Max pending lazy ops before a forced flush.  This valve bounds graph
+# *memory* (node objects held on the host); compiled-program *size* is
+# bounded separately by max_program_instrs below, so this can stay large.
+# (Safety valve; the reference DAG is unbounded but practical programs sync
+# often.)
 max_pending_ops = _env_int("RAMBA_TPU_MAX_PENDING", 10_000)
+
+# Max instructions per compiled XLA program.  A flush whose linearized
+# program exceeds this is segmented into chained jit calls of at most this
+# many instructions each (fuser._run_segmented).  XLA compile time grows
+# superlinearly with instruction count (a single 3000-op elementwise chain
+# took >2 min to compile on CPU); segments of a few hundred compile in
+# seconds, and repeated-structure chains reuse ONE compiled segment.  Set to
+# 0 to disable segmentation.
+max_program_instrs = _env_int("RAMBA_TPU_MAX_PROGRAM_INSTRS", 384)
 
 # How many mesh axes the default mesh is factored into (1..3).
 mesh_ndim = _env_int("RAMBA_TPU_MESH_NDIM", 2)

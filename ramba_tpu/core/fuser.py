@@ -68,7 +68,7 @@ _cache_epoch = 0
 
 # Monotone flush counter (observability; cf. reference dag-count history,
 # ramba.py:5120-5128).
-stats = {"flushes": 0, "compiles": 0, "nodes_flushed": 0}
+stats = {"flushes": 0, "compiles": 0, "nodes_flushed": 0, "segments": 0}
 
 
 def register_pending(arr) -> None:
@@ -229,9 +229,163 @@ def _program_label(program: _Program) -> str:
     return "prog_" + hashlib.sha256(text.encode()).hexdigest()[:12]
 
 
+def _get_compiled(program: _Program, donate_key: tuple):
+    """Compile-cache lookup (mesh-epoch aware).  Returns (fn, is_new)."""
+    global _cache_epoch
+    if _cache_epoch != _mesh.mesh_epoch:
+        _compile_cache.clear()
+        _cache_epoch = _mesh.mesh_epoch
+    key = (program.key, donate_key)
+    fn = _compile_cache.get(key)
+    if fn is not None:
+        return fn, False
+    if len(_compile_cache) >= _COMPILE_CACHE_MAX:
+        _compile_cache.pop(next(iter(_compile_cache)))
+    fn = jax.jit(_build_callable(program), donate_argnums=donate_key)
+    _compile_cache[key] = fn
+    stats["compiles"] += 1
+    return fn, True
+
+
+def _last_use_map(program: _Program) -> dict:
+    """slot -> highest slot index that consumes it; program outputs are
+    pinned past the end so they are never freed or donated."""
+    instrs, n_leaves = program.instrs, program.n_leaves
+    last_use: dict[int, int] = {}
+    for i, (_op, _st, args) in enumerate(instrs):
+        for s in args:
+            last_use[s] = n_leaves + i
+    inf = n_leaves + len(instrs) + 1
+    for s in program.out_slots:
+        last_use[s] = inf
+    return last_use
+
+
+def _iter_segments(program: _Program, last_use: dict):
+    """Split ``program`` into sub-programs of at most
+    ``common.max_program_instrs`` instructions.  Yields
+    ``(seg_prog, in_slots, out_here, top)`` where ``in_slots`` are the
+    parent-program value slots the segment consumes, ``out_here`` the
+    parent slots it must emit (used later or program outputs), and ``top``
+    the first parent slot index past this segment."""
+    instrs, n_leaves = program.instrs, program.n_leaves
+    seg_size = common.max_program_instrs
+    ninstr = len(instrs)
+    start = 0
+    while start < ninstr:
+        end = min(start + seg_size, ninstr)
+        base, top = n_leaves + start, n_leaves + end
+        seg = instrs[start:end]
+        in_slots = sorted(
+            {s for _o, _s, args in seg for s in args if s < base}
+        )
+        remap = {s: j for j, s in enumerate(in_slots)}
+        nin = len(in_slots)
+        seg_instrs = tuple(
+            (op, st, tuple(remap[s] if s < base else nin + (s - base)
+                           for s in args))
+            for op, st, args in seg
+        )
+        out_here = [s for s in range(base, top) if last_use.get(s, 0) >= top]
+        seg_prog = _Program(
+            seg_instrs,
+            nin,
+            tuple(program.leaf_kinds[s] if s < n_leaves else "C"
+                  for s in in_slots),
+            tuple(nin + (s - base) for s in out_here),
+        )
+        yield seg_prog, in_slots, out_here, top
+        start = end
+
+
+def _run_segmented(program: _Program, leaf_vals: list, donate_idx: tuple):
+    """Execute an oversized program as chained jit calls of at most
+    ``common.max_program_instrs`` instructions each.
+
+    XLA compile time grows superlinearly with program length (a 3000-op
+    elementwise chain took minutes on CPU), so one giant jit is a
+    scalability hazard the reference never hits only because its tests cap
+    chain length.  Segment boundaries cut the dataflow: values crossing a
+    boundary become segment outputs carried to the next call.  Each segment
+    is cached by its own structure, so a long chain of repeated ops compiles
+    ONE segment and reuses it; cross-segment intermediates that die inside a
+    segment are donated so the chain still updates HBM in place.
+    """
+    n_leaves = program.n_leaves
+    last_use = _last_use_map(program)
+    donate_set = set(donate_idx)
+    vals: dict[int, object] = dict(enumerate(leaf_vals))
+    for seg_prog, in_slots, out_here, top in _iter_segments(program, last_use):
+        seg_donate = []
+        for j, s in enumerate(in_slots):
+            if last_use.get(s, 0) >= top:
+                continue  # still live after this segment
+            if s < n_leaves and s not in donate_set:
+                continue  # caller-visible leaf not cleared for donation
+            if getattr(vals[s], "nbytes", 0) >= DONATE_MIN_BYTES:
+                seg_donate.append(j)
+        fn, is_new = _get_compiled(seg_prog, tuple(seg_donate))
+        seg_vals = [vals[s] for s in in_slots]
+        outs = _execute_compiled(fn, seg_prog, seg_vals, is_new)
+        del seg_vals
+        for s in in_slots:
+            if last_use.get(s, 0) < top:
+                del vals[s]
+        for s, v in zip(out_here, outs):
+            vals[s] = v
+        stats["segments"] += 1
+    return tuple(vals[s] for s in program.out_slots)
+
+
+def _execute_compiled(fn, program: _Program, leaf_vals, is_new: bool):
+    """Run one compiled program with the shared observability treatment:
+    RAMBA_SHOW_CODE dump on first compile, profiler TraceAnnotation at
+    RAMBA_TIMING>=2, and first-call (trace+lower+XLA compile) vs
+    steady-state timing attribution.  Used by both the monolithic and
+    segmented flush paths so the two can never drift."""
+    if is_new and common.show_code:
+        import sys
+
+        # jaxpr + lowered StableHLO (the reference's RAMBA_SHOW_CODE
+        # dumps the generated Numba source, ramba.py:8266-8284).
+        # Lowering only — compiling here would build a throwaway AOT
+        # executable the call below cannot reuse.
+        print(
+            jax.make_jaxpr(_build_callable(program))(*leaf_vals),
+            file=sys.stderr,
+        )
+        try:
+            print(fn.lower(*leaf_vals).as_text()[:20000], file=sys.stderr)
+        except Exception:
+            pass
+    t0 = time.perf_counter()
+    if common.timing_level > 1:
+        # label the dispatch in profiler traces (utils.timing.
+        # profiler_trace); off the hot path unless RAMBA_TIMING>=2
+        import jax.profiler as _prof
+
+        with _prof.TraceAnnotation(_program_label(program)):
+            outs = fn(*leaf_vals)
+    else:
+        outs = fn(*leaf_vals)
+    dt = time.perf_counter() - t0
+    if is_new:
+        # jax.jit compiles lazily: the first call pays trace+lower+XLA
+        # compile.  Attribute it separately so per-program execution times
+        # stay comparable.
+        _timing.add_time("trace_compile_first_call", dt)
+    else:
+        _timing.add_time("flush_execute", dt)
+        if common.timing_level > 0:  # label hashing is off the hot path
+            _timing.add_func_time(_program_label(program), dt)
+    return outs
+
+
 def flush(extra: Sequence[Expr] = ()) -> list:
     """Materialize every pending ndarray (and ``extra`` expressions) in one
-    fused jit call.  Returns the values of ``extra`` in order."""
+    fused jit call (or, above ``common.max_program_instrs`` instructions, a
+    chain of bounded jit calls — see ``_run_segmented``).  Returns the
+    values of ``extra`` in order."""
     global _nodes_since_flush
     _nodes_since_flush = 0
     roots = _pending_roots()
@@ -254,58 +408,18 @@ def flush(extra: Sequence[Expr] = ()) -> list:
         else:
             leaf_vals.append(leaf.value)
     donate_key = tuple(donate)
-    global _cache_epoch
-    if _cache_epoch != _mesh.mesh_epoch:
-        _compile_cache.clear()
-        _cache_epoch = _mesh.mesh_epoch
-    key = (program.key, donate_key)
-    fn = _compile_cache.get(key)
-    is_new = fn is None
-    if is_new:
-        if len(_compile_cache) >= _COMPILE_CACHE_MAX:
-            _compile_cache.pop(next(iter(_compile_cache)))
-        fn = jax.jit(_build_callable(program), donate_argnums=donate_key)
-        _compile_cache[key] = fn
-        stats["compiles"] += 1
-        if common.show_code:
-            import sys
-
-            # jaxpr + lowered StableHLO (the reference's RAMBA_SHOW_CODE
-            # dumps the generated Numba source, ramba.py:8266-8284).
-            # Lowering only — compiling here would build a throwaway AOT
-            # executable the jit call below cannot reuse.
-            print(
-                jax.make_jaxpr(_build_callable(program))(*leaf_vals),
-                file=sys.stderr,
-            )
-            try:
-                print(fn.lower(*leaf_vals).as_text()[:20000], file=sys.stderr)
-            except Exception:
-                pass
-    stats["flushes"] += 1
-    stats["nodes_flushed"] += len(program.instrs)
-    t0 = time.perf_counter()
     with warnings.catch_warnings():
         warnings.filterwarnings("ignore", message=".*[Dd]onat.*")
-        if common.timing_level > 1:
-            # label the dispatch in profiler traces (utils.timing.
-            # profiler_trace); off the hot path unless RAMBA_TIMING>=2
-            import jax.profiler as _prof
-
-            with _prof.TraceAnnotation(_program_label(program)):
-                outs = fn(*leaf_vals)
+        if (
+            common.max_program_instrs
+            and len(program.instrs) > common.max_program_instrs
+        ):
+            outs = _run_segmented(program, leaf_vals, donate_key)
         else:
-            outs = fn(*leaf_vals)
-    dt = time.perf_counter() - t0
-    if is_new:
-        # jax.jit compiles lazily: the first call pays trace+lower+XLA
-        # compile.  Attribute it separately so per-program execution times
-        # stay comparable.
-        _timing.add_time("trace_compile_first_call", dt)
-    else:
-        _timing.add_time("flush_execute", dt)
-        if common.timing_level > 0:  # label hashing is off the hot path
-            _timing.add_func_time(_program_label(program), dt)
+            fn, is_new = _get_compiled(program, donate_key)
+            outs = _execute_compiled(fn, program, leaf_vals, is_new)
+    stats["flushes"] += 1
+    stats["nodes_flushed"] += len(program.instrs)
     del leaf_vals
     for arr, val in zip(roots, outs[: len(roots)]):
         arr._set_expr(Const(val))
@@ -335,9 +449,56 @@ def analyze_pending() -> Optional[dict]:
         else:
             avals.append(jax.ShapeDtypeStruct(jax.numpy.asarray(v).shape,
                                               jax.numpy.asarray(v).dtype))
+    out = {"instructions": len(program.instrs), "n_leaves": program.n_leaves}
+    if (
+        common.max_program_instrs
+        and len(program.instrs) > common.max_program_instrs
+    ):
+        # The next flush will run segmented (_run_segmented), and compiling
+        # the monolith here would hit the very superlinear-compile hazard
+        # segmentation avoids — so analyze what will actually run: compile
+        # each distinct segment (chains repeat one structure) and report the
+        # PEAK per-segment sizes, chaining avals with jax.eval_shape.
+        # Sharding on intermediates is dropped (eval_shape carries none);
+        # GSPMD would propagate it, so temp sizes are an upper bound.
+        vals_avals = dict(enumerate(avals))
+        last_use = _last_use_map(program)
+        # keyed on structure AND input avals: seg_prog.key deliberately
+        # excludes shapes/dtypes, but memory numbers depend on them
+        seen_keys = {}
+        out["segments"] = 0
+        peak = {name: 0 for name in (
+            "temp_size_in_bytes", "argument_size_in_bytes",
+            "output_size_in_bytes", "generated_code_size_in_bytes")}
+        for seg_prog, in_slots, out_here, _top in _iter_segments(
+            program, last_use
+        ):
+            seg_avals = [vals_avals[s] for s in in_slots]
+            ak = (seg_prog.key,
+                  tuple((a.shape, str(a.dtype)) for a in seg_avals))
+            ma = seen_keys.get(ak)
+            if ma is None:
+                compiled = (
+                    jax.jit(_build_callable(seg_prog))
+                    .lower(*seg_avals)
+                    .compile()
+                )
+                ma = compiled.memory_analysis()
+                seen_keys[ak] = ma
+            for name in peak:
+                v = getattr(ma, name, None)
+                if v is not None:
+                    peak[name] = max(peak[name], v)
+            out_avals = jax.eval_shape(
+                _build_callable(seg_prog), *seg_avals
+            )
+            for s, av in zip(out_here, out_avals):
+                vals_avals[s] = av
+            out["segments"] += 1
+        out.update(peak)
+        return out
     compiled = jax.jit(_build_callable(program)).lower(*avals).compile()
     ma = compiled.memory_analysis()
-    out = {"instructions": len(program.instrs), "n_leaves": program.n_leaves}
     for name in ("temp_size_in_bytes", "argument_size_in_bytes",
                  "output_size_in_bytes", "generated_code_size_in_bytes"):
         out[name] = getattr(ma, name, None)
