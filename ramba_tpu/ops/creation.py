@@ -366,6 +366,13 @@ def geomspace(start, stop, num=50, endpoint=True, dtype=None,
     sgn = 1.0
     if start < 0 and stop < 0:
         sgn, start, stop = -1.0, -start, -stop
+    elif (start < 0) != (stop < 0):
+        # mixed signs would otherwise surface as an opaque math.log10
+        # domain error (ADVICE r4)
+        raise ValueError(
+            "Geometric sequence cannot calculate the step between "
+            f"start={start} and stop={stop} with different signs"
+        )
     out = sgn * logspace(math.log10(start), math.log10(stop), num,
                          endpoint=endpoint, distribution=distribution)
     return out.astype(dtype) if dtype is not None else out

@@ -486,11 +486,35 @@ def kaiser(M, beta):
 # data-dependent / driver-side host boundary (same line unique/nonzero draw)
 
 def partition(a, kth, axis=-1):
-    return np.partition(_host(a), kth, axis=axis)
+    """Device-side (round-4 verdict #5): jnp.partition lowers to an XLA
+    sort, whose output satisfies numpy's partition postcondition.  Sequence
+    ``kth`` is numpy-only; that rare path stays on host."""
+    import operator
+
+    try:
+        k = operator.index(kth)
+    except TypeError:
+        return np.partition(_host(a), kth, axis=axis)
+    if np.dtype(asarray(a).dtype).kind == "c":
+        # jnp.partition raises NotImplementedError for complex dtypes
+        return np.partition(_host(a), kth, axis=axis)
+    if axis is None:  # numpy: flatten first
+        return _lazy("partition", asarray(a).reshape(-1), kth=k, axis=-1)
+    return _lazy("partition", a, kth=k, axis=int(axis))
 
 
 def argpartition(a, kth, axis=-1):
-    return np.argpartition(_host(a), kth, axis=axis)
+    import operator
+
+    try:
+        k = operator.index(kth)
+    except TypeError:
+        return np.argpartition(_host(a), kth, axis=axis)
+    if np.dtype(asarray(a).dtype).kind == "c":
+        return np.argpartition(_host(a), kth, axis=axis)
+    if axis is None:  # numpy: flatten first
+        return _lazy("argpartition", asarray(a).reshape(-1), kth=k, axis=-1)
+    return _lazy("argpartition", a, kth=k, axis=int(axis))
 
 
 def setxor1d(ar1, ar2):
@@ -545,33 +569,93 @@ def apply_over_axes(func, a, axes):
     return np.apply_over_axes(func, _host(a), axes)
 
 
-# numpy's in-place mutators, via the framework's write-back machinery
+# numpy's in-place mutators, via the framework's write-back machinery.
+# Round-4 verdict #5: these used to round-trip the whole array through the
+# host (asarray -> numpy mutate -> re-upload: two full copies of a possibly
+# multi-GB distributed array).  Now the new value is built as a lazy
+# expression and assigned with ``a[...] = expr`` — one fused on-device
+# update, no host transfer.  The array's storage dtype governs the fill
+# values (numpy's same-kind cast), hence the explicit astype on ``values``.
+
+
+def _as_storage_dtype(values, dtype):
+    """Lazy cast of fill values to the target array's storage dtype."""
+    return asarray(values).astype(dtype)
+
+
+@defop("fill_diag_wrap")
+def _op_fill_diag_wrap(static, a, val):
+    # numpy's wrapped diagonal: a.flat[::ncols+1] with NO end clamp
+    # (jnp.fill_diagonal rejects wrap=True)
+    step = a.shape[1] + 1
+    num = -(-a.size // step)  # ceil
+    idx = jnp.arange(num) * step
+    v = jnp.ravel(val)
+    fills = v[jnp.arange(num) % v.size].astype(a.dtype)
+    return jnp.ravel(a).at[idx].set(fills).reshape(a.shape)
+
 
 def fill_diagonal(a, val, wrap=False):
-    buf = _host(a).copy()
-    np.fill_diagonal(buf, _host(val) if hasattr(val, "asarray") else val,
-                     wrap=wrap)
-    a[...] = buf
+    if not isinstance(a, ndarray):
+        return np.fill_diagonal(a, _host(val), wrap=wrap)
+    if wrap and a.ndim == 2 and a.shape[0] > a.shape[1]:
+        a[...] = ndarray(Node("fill_diag_wrap", (), [
+            as_exprable(a),
+            as_exprable(_as_storage_dtype(val, a.dtype))]))
+        return None
+    a[...] = _lazy("fill_diagonal", a, _as_storage_dtype(val, a.dtype),
+                   inplace=False)
+
+
+@defop("putmask")
+def _op_putmask(static, a, mask, values):
+    # numpy.putmask cycles ``values`` over the FLAT positions of ``a``
+    # (not over the True positions — that is ``place``)
+    v = jnp.ravel(values)
+    cycled = jnp.reshape(v[jnp.arange(a.size) % v.size], a.shape)
+    return jnp.where(jnp.reshape(mask, a.shape), cycled, a)
+
+
+def _host_masked_write(np_fn, a, mask, values):
+    """Shared host fallback for putmask/place (non-ndarray target or empty
+    values, where numpy's own error/semantics should apply verbatim)."""
+    buf = _host(a).copy() if isinstance(a, ndarray) else a
+    np_fn(buf, _host(mask),
+          np.asarray(_host(values)).astype(buf.dtype, copy=False))
+    if isinstance(a, ndarray):
+        a[...] = buf
 
 
 def putmask(a, mask, values):
-    buf = _host(a).copy()
-    # the array's storage dtype governs (x32 regime stores f32; numpy's
-    # same-kind cast of f64 fill values into it matches a[mask] = values)
-    vals = np.asarray(_host(values)).astype(buf.dtype, copy=False)
-    np.putmask(buf, _host(mask), vals)
-    a[...] = buf
+    if not isinstance(a, ndarray) or _size_of(values) == 0:
+        return _host_masked_write(np.putmask, a, mask, values)
+    a[...] = ndarray(Node("putmask", (), [
+        as_exprable(a), as_exprable(asarray(mask)),
+        as_exprable(_as_storage_dtype(values, a.dtype))]))
 
 
 def place(arr, mask, vals):
-    buf = _host(arr).copy()
-    v = np.asarray(_host(vals)).astype(buf.dtype, copy=False)
-    np.place(buf, _host(mask), v)
-    arr[...] = buf
+    if not isinstance(arr, ndarray) or _size_of(vals) == 0:
+        return _host_masked_write(np.place, arr, mask, vals)
+    arr[...] = _lazy("place", arr, mask,
+                     _as_storage_dtype(vals, arr.dtype), inplace=False)
 
 
 def put_along_axis(arr, indices, values, axis):
-    buf = _host(arr).copy()
-    v = np.asarray(_host(values)).astype(buf.dtype, copy=False)
-    np.put_along_axis(buf, _host(indices), v, axis)
-    arr[...] = buf
+    if not isinstance(arr, ndarray):
+        return np.put_along_axis(arr, _host(indices), _host(values), axis)
+    vals = _as_storage_dtype(values, arr.dtype)
+    if axis is None:  # numpy: destination treated as flattened
+        flat = _lazy("put_along_axis", arr.reshape(-1), indices, vals,
+                     axis=0, inplace=False)
+        arr[...] = flat.reshape(arr.shape)
+        return None
+    arr[...] = _lazy("put_along_axis", arr, indices, vals, axis=int(axis),
+                     inplace=False)
+
+
+def _size_of(x) -> int:
+    """Element count probe that never materializes a distributed array."""
+    if isinstance(x, ndarray):
+        return int(np.prod(x.shape, dtype=np.int64))
+    return np.asarray(x).size

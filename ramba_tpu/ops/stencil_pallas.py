@@ -246,11 +246,9 @@ def _run_fast(func, lo, hi, slots, arrs, taps):
                     call_args.append(payload.v)
             return call_args
 
-        try:
-            val = _unwrap(func(*build(False)))
-        except (jax.errors.TracerArrayConversionError, TypeError):
-            val = _unwrap(func(*build(True)))
-        val = val.astype(dtype)
+        from ramba_tpu.skeletons import call_stencil_body
+
+        val = call_stencil_body(func, build).astype(dtype)
         gr = jax.lax.broadcasted_iota(jnp.int32, (bh, W), 0) + i * bh
         gc = jax.lax.broadcasted_iota(jnp.int32, (bh, W), 1)
         valid = (gr >= top) & (gr < H - bottom) & (gc >= left) & (gc < W - right)
@@ -309,10 +307,7 @@ def _run_padded(func, lo, hi, slots, arrs, taps=8):
 
     padded = [pad(a) for a in arrs]
 
-    def make_kernel(wrap):
-        return lambda *refs: _kernel_body(wrap, *refs)
-
-    def _kernel_body(wrap, *refs):
+    def _kernel_body(*refs):
         # refs: n_slabs HBM inputs, out_ref, n_slabs VMEM scratch, 1 sem
         ins = refs[:n_slabs]
         out_ref = refs[n_slabs]
@@ -329,7 +324,7 @@ def _run_padded(func, lo, hi, slots, arrs, taps=8):
             cp.start()
             cp.wait()
 
-        from ramba_tpu.skeletons import _KVal, _unwrap
+        from ramba_tpu.skeletons import _KVal, call_stencil_body
 
         class _Shift:
             def __init__(self, ref, wrap_vals):
@@ -345,15 +340,18 @@ def _run_padded(func, lo, hi, slots, arrs, taps=8):
                 ]
                 return _KVal(piece) if self.wrap_vals else piece
 
-        call_args = []
-        ai = 0
-        for kind, payload in slots:
-            if kind == "arr":
-                call_args.append(_Shift(slabs[ai], wrap))
-                ai += 1
-            else:
-                call_args.append(payload.v)
-        val = _unwrap(func(*call_args)).astype(dtype)
+        def build_args(wrap):
+            call_args = []
+            ai = 0
+            for kind, payload in slots:
+                if kind == "arr":
+                    call_args.append(_Shift(slabs[ai], wrap))
+                    ai += 1
+                else:
+                    call_args.append(payload.v)
+            return call_args
+
+        val = call_stencil_body(func, build_args).astype(dtype)
         # zero the stencil border in-kernel (cells whose neighborhood
         # leaves the valid array) — saves a full masking pass afterwards
         gr = jax.lax.broadcasted_iota(jnp.int32, (bh, Wo), 0) + i * bh
@@ -361,27 +359,20 @@ def _run_padded(func, lo, hi, slots, arrs, taps=8):
         valid = (gr >= top) & (gr < H - bottom) & (gc >= left) & (gc < W - right)
         out_ref[:] = jnp.where(valid, val, jnp.zeros((), dtype))
 
-    def build(wrap):
-        # out_shape is the exact result shape: pallas clips partial edge
-        # blocks, and the kernel masks the stencil border itself, so no
-        # post-processing pass is needed.
-        return pl.pallas_call(
-            make_kernel(wrap),
-            grid=(grid,),
-            out_shape=jax.ShapeDtypeStruct((H, W), dtype),
-            in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * n_slabs,
-            out_specs=pl.BlockSpec((bh, Wo), lambda i: (i, 0),
-                                   memory_space=pltpu.VMEM),
-            scratch_shapes=(
-                [pltpu.VMEM((slab_h, Wi), dtype)] * n_slabs
-                + [pltpu.SemaphoreType.DMA]
-            ),
-            interpret=_INTERPRET,
-        )(*padded)
-
-    try:
-        return build(False)
-    except (jax.errors.TracerArrayConversionError, TypeError):
-        # kernel body reached for NumPy, which can't consume tracers —
-        # retry with ufunc-rerouting proxies (cf. skeletons._call_kernel)
-        return build(True)
+    # out_shape is the exact result shape: pallas clips partial edge
+    # blocks, and the kernel masks the stencil border itself, so no
+    # post-processing pass is needed.  The NumPy-ufunc retry and branch
+    # auto-lowering happen inside the kernel body (call_stencil_body).
+    return pl.pallas_call(
+        _kernel_body,
+        grid=(grid,),
+        out_shape=jax.ShapeDtypeStruct((H, W), dtype),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * n_slabs,
+        out_specs=pl.BlockSpec((bh, Wo), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=(
+            [pltpu.VMEM((slab_h, Wi), dtype)] * n_slabs
+            + [pltpu.SemaphoreType.DMA]
+        ),
+        interpret=_INTERPRET,
+    )(*padded)

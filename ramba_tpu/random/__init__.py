@@ -134,7 +134,11 @@ def standard_normal(size=None):
 
 def exponential(scale=1.0, size=None):
     out = _rand("exponential", size, jnp.zeros(0).dtype)
-    return out * scale if scale != 1.0 else out
+    # array-like scale multiplies elementwise (the scalar-1.0 fast path
+    # would raise "truth value is ambiguous" on arrays, ADVICE r4)
+    if np.ndim(scale) == 0 and scale == 1.0:
+        return out
+    return out * scale
 
 
 def poisson(lam=1.0, size=None):
@@ -147,7 +151,9 @@ def beta(a, b, size=None):
 
 def gamma(shape, scale=1.0, size=None):
     out = _rand("gamma", size, jnp.zeros(0).dtype, (float(shape),))
-    return out * scale if scale != 1.0 else out
+    if np.ndim(scale) == 0 and scale == 1.0:
+        return out
+    return out * scale
 
 
 def binomial(n, p, size=None):
@@ -163,8 +169,11 @@ def permutation(x):
     if isinstance(x, (int, np.integer)):
         n = int(x)
         spec = tuple(_mesh.default_spec((n,)))
+        # int64 under x64, int32 under the TPU x32 regime — numpy returns
+        # int64 (ADVICE r4: hard-coded int32 was a dtype parity gap)
+        dt = str(jax.dtypes.canonicalize_dtype(np.int64))
         return ndarray(
-            Node("random", ("permutation", (n,), "int32", spec),
+            Node("random", ("permutation", (n,), dt, spec),
                  [Const(_next_key())])
         )
     a = _asarray(x)

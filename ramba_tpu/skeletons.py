@@ -56,14 +56,114 @@ class KernelTraceError(RuntimeError):
     answers are never an option (round-3 verdict weak #2)."""
 
 
+class KernelBranchError(KernelTraceError):
+    """Specifically a data-dependent ``if`` — the recoverable case: the
+    two-sided branch trace can usually lower it to ``jnp.where``."""
+
+
 _BRANCH_MSG = (
-    "kernel branches on a traced value (e.g. `if x > 0:`), which jax cannot "
-    "compile. Rewrite the branch as `np.where(cond, a, b)` / `jnp.where` "
-    "(runs on TPU), or accept the slow host-evaluation fallback where the "
-    "skeleton provides one (smap/smap_index). The reference compiles such "
-    "kernels with Numba on CPU (ramba.py:1600-1694); on TPU data-dependent "
-    "control flow must be expressed as `where`/`lax.cond`."
+    "kernel has data-dependent control flow jax cannot compile and the "
+    "two-sided branch trace cannot express (simple `if x > 0:` branches "
+    "are auto-lowered to where(); this one is not — e.g. a data-dependent "
+    "loop count, float()/int() conversion feeding control flow, or too "
+    "many branch paths). Rewrite with `np.where`/`jnp.where`/`lax.cond`, "
+    "or accept the slow host-evaluation fallback where the skeleton "
+    "provides one (smap/smap_index). The reference compiles such kernels "
+    "with Numba on CPU (ramba.py:1600-1694)."
 )
+
+
+# --- two-sided branch tracing (round-4 verdict #6) --------------------------
+# A kernel that branches on data (`if x > 0:`) is re-executed once per
+# reachable branch path with forced True/False decisions; the recorded
+# branch conditions then combine the per-path results with nested
+# ``jnp.where`` — per-element semantics, exactly what the reference's
+# Numba-compiled per-element kernels give (ramba.py:1600-1694), but on
+# device.  Caveats (documented in docs/index.md): BOTH sides of every
+# branch execute (side effects fire on every path; untaken-branch math may
+# produce inf/nan that the `where` then discards), results promote to a
+# common dtype, and the kernel must be deterministic.  Data-dependent LOOP
+# counts are not expressible this way — the depth cap below turns them into
+# a KernelTraceError, and smap's host fallback takes over.
+
+_MAX_BRANCH_DEPTH = 16
+_MAX_BRANCH_PATHS = 64
+
+_active_decider = None
+
+
+class _Decider:
+    """One kernel execution's branch decisions: replays ``forced`` then
+    defaults to True, recording every decision and its traced condition."""
+
+    __slots__ = ("forced", "decisions", "conds")
+
+    def __init__(self, forced):
+        self.forced = tuple(forced)
+        self.decisions = []
+        self.conds = []
+
+    def decide(self, cond):
+        i = len(self.decisions)
+        if i >= _MAX_BRANCH_DEPTH:
+            raise KernelTraceError(
+                "kernel exceeded the branch-enumeration depth limit "
+                f"({_MAX_BRANCH_DEPTH}); a data-dependent loop cannot be "
+                "lowered to where(). " + _BRANCH_MSG
+            )
+        d = self.forced[i] if i < len(self.forced) else True
+        self.decisions.append(d)
+        self.conds.append(cond)
+        return d
+
+
+def _explore_branches(run):
+    """Enumerate every reachable branch path of ``run`` by re-executing it
+    under forced decisions.  Returns [(path, conds, result), ...] leaves."""
+    global _active_decider
+    leaves = []
+    pending = [()]
+    while pending:
+        if len(leaves) >= _MAX_BRANCH_PATHS:
+            raise KernelTraceError(
+                f"kernel has over {_MAX_BRANCH_PATHS} branch paths. "
+                + _BRANCH_MSG
+            )
+        prefix = pending.pop()
+        dec = _Decider(prefix)
+        prev = _active_decider
+        _active_decider = dec
+        try:
+            out = run()
+        finally:
+            _active_decider = prev
+        path = tuple(dec.decisions)
+        leaves.append((path, dec.conds, out))
+        for d in range(len(prefix), len(path)):
+            pending.append(path[:d] + (False,))
+    return leaves
+
+
+def _combine_branches(leaves):
+    """Fold branch-path results into one value with nested jnp.where over
+    the recorded conditions (scalar conds inside vectorize; array conds in
+    stencil bodies — both mean per-element selection)."""
+    exact = {path: out for path, _c, out in leaves}
+    cond_at = {}
+    for path, conds, _o in leaves:
+        for d in range(len(path)):
+            cond_at.setdefault(path[:d], conds[d])
+
+    def build(prefix):
+        if prefix in exact:
+            return _unwrap(exact[prefix])
+        return jnp.where(
+            _unwrap(cond_at[prefix]),
+            build(prefix + (True,)),
+            build(prefix + (False,)),
+        )
+
+    return build(())
 
 
 class _KVal:
@@ -78,7 +178,9 @@ class _KVal:
         self.v = v
 
     def __bool__(self):
-        raise KernelTraceError(_BRANCH_MSG)
+        if _active_decider is not None:
+            return _active_decider.decide(self.v)
+        raise KernelBranchError(_BRANCH_MSG)
 
     def __float__(self):
         raise KernelTraceError(
@@ -163,25 +265,43 @@ def _install_kval_ops():
 _install_kval_ops()
 
 
+def _kwrap(vals):
+    def wrap(v):
+        if isinstance(v, tuple):  # e.g. smap_index's index tuple
+            return tuple(wrap(e) for e in v)
+        if isinstance(v, (jax.Array, jnp.ndarray)) or hasattr(v, "aval"):
+            return _KVal(v)
+        return v
+
+    return [wrap(v) for v in vals]
+
+
 def _call_kernel(func, *vals):
     """Call a user kernel on traced values; if it reaches for NumPy (which
     cannot consume tracers), retry with _KVal proxies.  A kernel that
-    branches on data raises KernelTraceError from the retry (never a silent
-    wrong answer): smap converts that into a host fallback, other skeletons
-    let it surface."""
+    branches on data is auto-lowered via the two-sided branch trace
+    (``_explore_branches`` + ``jnp.where`` combine); only kernels the trace
+    cannot express (float()/int() conversion, data-dependent loop counts,
+    path explosion) raise KernelTraceError — smap converts that into a host
+    fallback, other skeletons let it surface loudly (never a silent wrong
+    answer)."""
+    branched = False
     try:
         return _unwrap(func(*vals))
     except jax.errors.TracerBoolConversionError:
-        # Data-dependent Python branch on a raw tracer: the _KVal retry
-        # below would raise the same thing with a better message.
-        raise KernelTraceError(_BRANCH_MSG) from None
+        branched = True  # branch on a raw tracer: enumerate below
     except (jax.errors.TracerArrayConversionError, TypeError):
-        wrapped = [
-            _KVal(v) if isinstance(v, (jax.Array, jnp.ndarray)) or hasattr(v, "aval")
-            else v
-            for v in vals
-        ]
-        return _unwrap(func(*wrapped))
+        try:
+            return _unwrap(func(*_kwrap(vals)))
+        except KernelBranchError:
+            branched = True
+        # float()/int() conversions raise plain KernelTraceError and are
+        # not expressible as where(): let them propagate
+    if not branched:  # pragma: no cover - defensive
+        raise KernelTraceError(_BRANCH_MSG)
+    wrapped = _kwrap(vals)
+    leaves = _explore_branches(lambda: func(*wrapped))
+    return _combine_branches(leaves)
 
 
 class _Lit:
@@ -463,9 +583,12 @@ class _ProbeValue:
 
     def __bool__(self):
         # A branch during the offset probe would silently hide the
-        # not-taken branch's neighborhood; stencil kernels must be
-        # branch-free (use np.where).
-        raise KernelTraceError(_BRANCH_MSG)
+        # not-taken branch's neighborhood — under the branch enumerator
+        # every path runs, so the union of offsets is captured; without it
+        # (direct host __call__ path) refuse loudly (use np.where).
+        if _active_decider is not None:
+            return _active_decider.decide(None)
+        raise KernelBranchError(_BRANCH_MSG)
 
 
 class _ProbeProxy:
@@ -531,7 +654,9 @@ class StencilKernel:
                 else:
                     call_args.append(payload.v)
             try:
-                self.func(*call_args)
+                # branch enumeration visits every path, so a branching
+                # kernel's probe records the UNION of both sides' offsets
+                _explore_branches(lambda: self.func(*call_args))
             except Exception as e:  # kernel must be offset-indexing only
                 raise ValueError(
                     f"could not probe stencil kernel {self.func}: {e}"
@@ -592,11 +717,34 @@ def stencil_interior(func, lo, hi, slots, arrs):
                 out.append(payload.v)
         return out
 
+    return call_stencil_body(func, build_args)
+
+
+def call_stencil_body(func, build_args):
+    """Evaluate a stencil body given ``build_args(wrap) -> call_args``
+    (shift proxies over slices — XLA path — or VMEM slabs — Pallas path).
+    Handles the NumPy-ufunc retry and auto-lowers data branches: a
+    per-element ``if`` in the reference's Numba kernels becomes an
+    array-shaped where() here, the branch condition being a shifted slice,
+    so the two-sided combine selects per point."""
     try:
-        val = func(*build_args(False))
+        return _unwrap(func(*build_args(False)))
+    except jax.errors.TracerBoolConversionError:
+        pass  # branch on a raw traced scalar: enumerate below
+    except ValueError as e:
+        # non-scalar slices (traced or concrete) raise "The truth value of
+        # an array ... is ambiguous" on a data branch; any OTHER ValueError
+        # is a genuine kernel bug and must surface from the original call
+        if "truth value" not in str(e) and "ambiguous" not in str(e):
+            raise
     except (jax.errors.TracerArrayConversionError, TypeError):
-        val = _unwrap(func(*build_args(True)))
-    return _unwrap(val)
+        try:
+            return _unwrap(func(*build_args(True)))
+        except KernelBranchError:
+            pass
+    wrapped = build_args(True)
+    leaves = _explore_branches(lambda: func(*wrapped))
+    return _combine_branches(leaves)
 
 
 def _eval_stencil(static, *arrs):
@@ -836,6 +984,38 @@ def _op_scumulative(static, x):
     return jnp.moveaxis(out, 0, axis)
 
 
+_warned_nonassoc = False
+
+
+def _warn_nonassoc_sharded(arr, axis) -> None:
+    """Round-4 verdict #8: a non-rebasable kernel on a sharded scan axis is
+    exact only per block (per-block carry semantics, same as the
+    reference's scumulative_final) — say so loudly, once."""
+    global _warned_nonassoc
+    if _warned_nonassoc:
+        return
+    import warnings
+
+    mesh = _mesh.get_mesh()
+    nsh = int(np.prod(list(mesh.shape.values())))
+    n = arr.shape[axis] if arr.ndim else 0
+    if nsh <= 1 or n < max(nsh * 2, common.dist_threshold):
+        return  # single-shard path: exact sequential semantics
+    _warned_nonassoc = True
+    warnings.warn(
+        "scumulative: the kernel failed the associativity probe and the "
+        f"scan axis is sharded over {nsh} devices.  Each shard scans its own "
+        "block and the cross-shard carry is applied via final_func(boundary, "
+        "block) — per-block carry semantics, identical to the reference's "
+        "scumulative_final, which can differ from an exact sequential scan "
+        "for non-rebasable kernels (e.g. clamped accumulators).  Pass "
+        "associative=True if the kernel is in fact associative, or keep the "
+        "scan axis unsharded for exact semantics.",
+        RuntimeWarning,
+        stacklevel=3,
+    )
+
+
 def scumulative(local_func, final_func, arr, axis=0, dtype=None, out=None,
                 *, associative=None):
     """Reference: ramba.scumulative (docs/index.md:219-243,
@@ -866,6 +1046,8 @@ def scumulative(local_func, final_func, arr, axis=0, dtype=None, out=None,
         arr = arr.astype(dtype)
     if associative is None:
         associative = _probe_associative(local_func, final_func)
+    if not associative:
+        _warn_nonassoc_sharded(arr, axis)
     res = ndarray(
         Node(
             "scumulative",
@@ -1024,11 +1206,22 @@ class LocalView:
         data and False in the zero-padding of an uneven distribution.
         Use to bound block-coupled computations, e.g.
         ``masked = jnp.where(lv.valid_mask, lv.get_local(), identity)``."""
+        cur = self.get_local().shape
+        if cur != self._block.shape:
+            # valid counts are defined in the ORIGINAL block's coordinates;
+            # a reshaped slab (e.g. halo-extended via set_local) would get a
+            # silently misaligned mask (ADVICE r4) — refuse loudly instead
+            raise ValueError(
+                f"valid_mask refers to the original {self._block.shape} "
+                f"block but the local slab is now {cur}; read valid_mask "
+                "before a shape-changing set_local(), or mask manually "
+                "with local_valid"
+            )
         valid = self.local_valid
-        mask = jnp.ones(self._block.shape, bool)
+        mask = jnp.ones(cur, bool)
         for d, nv in enumerate(valid):
-            idx = jnp.arange(self._block.shape[d])
-            shape = [1] * self._block.ndim
+            idx = jnp.arange(cur[d])
+            shape = [1] * len(cur)
             shape[d] = -1
             mask = mask & (idx.reshape(shape) < nv)
         return mask

@@ -105,15 +105,24 @@ def drain_effect_errors() -> Exception | None:
     the token state.  jax's own ``block_until_ready`` skips its ``clear()``
     when a token raises, hence the explicit clear here.
     """
-    from jax._src import dispatch as _dispatch
+    try:
+        # private API — can vanish or change shape on a jax upgrade;
+        # this is a best-effort debug helper, so degrade to a no-op
+        from jax._src import dispatch as _dispatch
 
+        tokens = _dispatch.runtime_tokens
+    except (ImportError, AttributeError):
+        return None
     err: Exception | None = None
     try:
-        _dispatch.runtime_tokens.block_until_ready()
+        tokens.block_until_ready()
     except Exception as e:  # noqa: BLE001 - error is the return value
         err = e
     finally:
-        _dispatch.runtime_tokens.clear()
+        try:
+            tokens.clear()
+        except Exception:  # noqa: BLE001
+            pass
     return err
 
 

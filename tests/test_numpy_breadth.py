@@ -242,6 +242,144 @@ class TestMutators:
         np.put_along_axis(w, idx, 0.0, 1)
         _cmp(np.asarray(a), w)
 
+    def test_axis_none_paths(self):
+        # numpy treats axis=None as flatten-first for these three
+        v = np.random.RandomState(12).rand(4, 6)
+        p = np.asarray(rt.partition(rt.fromarray(v), 5, axis=None))
+        assert (p[:5] <= p[5]).all() and (p[6:] >= p[5]).all()
+        gi = np.asarray(rt.argpartition(rt.fromarray(v), 5, axis=None))
+        fv = v.ravel()
+        assert (fv[gi[:5]] <= fv[gi[5]]).all()
+        a = rt.fromarray(v.copy())
+        w = v.copy()
+        idx = np.array([3, 7])
+        rt.put_along_axis(a, idx, 9.0, None)
+        np.put_along_axis(w, idx, 9.0, None)
+        _cmp(np.asarray(a), w)
+
+    def test_fill_diagonal_wrap_and_array_val(self):
+        v = np.zeros((7, 3))
+        a = rt.fromarray(v.copy())
+        rt.fill_diagonal(a, np.array([1.0, 2.0, 3.0]), wrap=True)
+        w = v.copy()
+        np.fill_diagonal(w, np.array([1.0, 2.0, 3.0]), wrap=True)
+        np.testing.assert_array_equal(np.asarray(a), w)
+
+    def test_mutators_stay_on_device(self):
+        # round-4 verdict #5: no _host() round-trip for distributed inputs
+        # — the whole-array device->host gather (2 copies of a big array)
+        # is the thing being regression-tested, via the comm counter
+        from ramba_tpu.utils.timing import comm_stats
+
+        n = 256  # (256, 256) = 65k elements, well over the 20k bar
+        v = np.random.RandomState(8).rand(n, n).astype(np.float32)
+        w = v.copy()
+        a = rt.fromarray(v)
+        rt.sync()
+        before = comm_stats["device_to_host_bytes"]
+
+        rt.fill_diagonal(a, 7.0)
+        np.fill_diagonal(w, 7.0)
+        rt.putmask(a, w > 0.5, np.array([-1.0, -2.0], np.float32))
+        np.putmask(w, w > 0.5, np.array([-1.0, -2.0], np.float32))
+        rt.place(a, w < 0.25, np.array([9.0], np.float32))
+        np.place(w, w < 0.25, np.array([9.0], np.float32))
+        idx = np.argmin(w, axis=1, keepdims=True)
+        rt.put_along_axis(a, idx, 5.0, 1)
+        np.put_along_axis(w, idx, 5.0, 1)
+        p = rt.partition(a.reshape(-1), 1000)
+        gi = rt.argpartition(a.reshape(-1), 1000)
+        rt.sync()
+        assert comm_stats["device_to_host_bytes"] == before, (
+            "mutators transferred distributed data to the host"
+        )
+        _cmp(np.asarray(a), w)
+        pf = np.asarray(p)
+        assert (pf[:1000] <= pf[1000]).all() and (pf[1001:] >= pf[1000]).all()
+        wf = np.asarray(a).ravel()
+        gif = np.asarray(gi)
+        assert (wf[gif[:1000]] <= wf[gif[1000]]).all()
+
+
+class TestReductionWhereInitial:
+    """where=/initial= accepted as fused lazy lowerings (round-4 verdict
+    #10; the reference's module-level wrappers reject them,
+    ramba.py:7996-8031)."""
+
+    def setup_method(self):
+        rng = np.random.RandomState(11)
+        self.v = rng.randn(6, 7)
+        self.m = rng.rand(6, 7) > 0.4
+
+    def _both(self, fn, np_fn, **kw):
+        a = rt.fromarray(self.v)
+        for axis in (None, 0, 1):
+            got = fn(a, axis=axis, **kw)
+            want = np_fn(self.v, axis=axis, **kw)
+            np.testing.assert_allclose(np.asarray(got), want, rtol=1e-12)
+
+    def test_sum_where_initial(self):
+        self._both(rt.sum, np.sum, where=self.m)
+        self._both(rt.sum, np.sum, where=self.m, initial=5.0)
+        self._both(rt.sum, np.sum, initial=-2.0)
+
+    def test_prod_where_initial(self):
+        self._both(rt.prod, np.prod, where=self.m)
+        self._both(rt.prod, np.prod, where=self.m, initial=0.5)
+
+    def test_min_max_where_requires_initial(self):
+        self._both(rt.min, np.min, where=self.m, initial=10.0)
+        self._both(rt.max, np.max, where=self.m, initial=-10.0)
+        self._both(rt.min, np.min, initial=-100.0)
+        with pytest.raises(ValueError, match="identity"):
+            rt.min(rt.fromarray(self.v), where=self.m)
+
+    def test_min_max_where_integer(self):
+        vi = (self.v * 10).astype(np.int64)
+        a = rt.fromarray(vi)
+        got = rt.min(a, where=self.m, initial=np.int64(99))
+        want = np.min(vi, where=self.m, initial=np.int64(99))
+        assert int(got) == int(want)
+
+    def test_min_max_where_bool(self):
+        b = self.v > 0
+        m = self.m
+        a = rt.fromarray(b)
+        assert bool(rt.min(a, where=m, initial=True)) == bool(
+            np.min(b, where=m, initial=True))
+        assert bool(rt.max(a, where=m, initial=False)) == bool(
+            np.max(b, where=m, initial=False))
+
+    def test_mean_where_dtype(self):
+        a = rt.fromarray(self.v)
+        got = rt.mean(a, dtype=np.int32, where=self.m)
+        want = np.mean(self.v, dtype=np.int32, where=self.m)
+        assert np.asarray(got).dtype == want.dtype
+
+    def test_any_all_where(self):
+        b = self.v > 0
+        a = rt.fromarray(b)
+        for axis in (None, 0, 1):
+            np.testing.assert_array_equal(
+                np.asarray(rt.any(a, axis=axis, where=self.m)),
+                np.any(b, axis=axis, where=self.m))
+            np.testing.assert_array_equal(
+                np.asarray(rt.all(a, axis=axis, where=self.m)),
+                np.all(b, axis=axis, where=self.m))
+
+    def test_mean_where(self):
+        self._both(rt.mean, np.mean, where=self.m)
+
+    def test_where_stays_lazy_and_fused(self):
+        from ramba_tpu.core import fuser
+
+        a = rt.fromarray(self.v)
+        rt.sync()
+        before = dict(fuser.stats)
+        s = rt.sum(a * 2.0 + 1.0, where=self.m)
+        float(s)
+        assert fuser.stats["flushes"] - before["flushes"] == 1
+
 
 class TestNumpyDispatch:
     def test_np_namespace_routes_to_framework(self):
@@ -313,9 +451,22 @@ class TestRandomBreadth:
         sn = np.asarray(rt.random.standard_normal(20000))
         assert abs(sn.mean()) < 0.05 and abs(sn.std() - 1.0) < 0.05
 
+    def test_scale_accepts_arrays(self):
+        # ADVICE r4: `scale != 1.0` raised "truth value is ambiguous"
+        scales = np.array([1.0, 2.0, 4.0, 8.0])
+        e = np.asarray(rt.random.exponential(scales, size=4))
+        assert e.shape == (4,) and (e >= 0).all()
+        g = np.asarray(rt.random.gamma(3.0, scales, size=4))
+        assert g.shape == (4,) and (g > 0).all()
+
     def test_permutation_and_shuffle(self):
         perm = np.asarray(rt.random.permutation(257))
         assert sorted(perm) == list(range(257))
+        # dtype parity (ADVICE r4): int64 under x64, int32 in x32 regime
+        import jax as _jax
+
+        want = np.int64 if _jax.config.jax_enable_x64 else np.int32
+        assert perm.dtype == want, perm.dtype
         arr = rt.fromarray(np.arange(100.0))
         pa = np.asarray(rt.random.permutation(arr))
         assert sorted(pa) == list(range(100))
@@ -398,6 +549,9 @@ class TestCreationIOBreadth:
              rtol=1e-6)
         with pytest.raises(ValueError):
             rt.geomspace(0, 10, 5)
+        # mixed signs: clear ValueError, not an opaque log10 domain error
+        with pytest.raises(ValueError, match="sign"):
+            rt.geomspace(-1, 10, 5)
 
     def test_from_variants(self):
         np.testing.assert_array_equal(
