@@ -315,10 +315,21 @@ class ndarray:
     def asarray(self) -> np.ndarray:
         """Gather to a host NumPy array (reference: ndarray.asarray,
         ramba.py:5735-5765 — per-worker get_view + driver assembly; here a
-        single device-to-host transfer)."""
+        single device-to-host transfer).  Under multi-controller SPMD
+        (jax.process_count() > 1) shards live on other processes'
+        devices; an all-gather collective assembles the full value on
+        EVERY process — the reference's MPI mode does the same driver
+        assembly over its comm queues.  All processes must call this in
+        lockstep (they do: each runs the same program)."""
         from ramba_tpu.utils import timing as _timing
 
-        out = np.asarray(self._value())
+        v = self._value()
+        if not v.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            out = np.asarray(multihost_utils.process_allgather(v, tiled=True))
+        else:
+            out = np.asarray(v)
         _timing.note_transfer("device_to_host", out.nbytes)
         return out
 
@@ -616,14 +627,32 @@ def as_exprable(x) -> Expr:
     return E.as_expr(x)
 
 
+def put_sharded(x, sharding):
+    """Upload a host array under ``sharding``.  Under multi-controller SPMD
+    the sharding spans processes, where a plain ``device_put`` of host data
+    aborts in native code — instead each process materializes only its own
+    addressable shards from the (identical, SPMD) host copy via
+    ``make_array_from_callback`` (the reference's MPI mode likewise has
+    every rank slice its own piece out of the rank-local copy,
+    common.py:49-100)."""
+    if jax.process_count() > 1 and getattr(sharding, "mesh", None) is not None:
+        xn = np.asarray(x)
+        return jax.make_array_from_callback(
+            xn.shape, sharding, lambda idx: xn[idx]
+        )
+    return jax.device_put(x, sharding)
+
+
 def _device_put_default(x):
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        return x  # already a global (cross-process) array: keep as is
     x = np.asarray(x) if not isinstance(x, jax.Array) else x
     if isinstance(x, np.ndarray):
         from ramba_tpu.utils import timing as _timing
 
         _timing.note_transfer("host_to_device", x.nbytes)
     try:
-        return jax.device_put(x, _mesh.default_sharding(x.shape))
+        return put_sharded(x, _mesh.default_sharding(x.shape))
     except Exception:
         return jnp.asarray(x)
 

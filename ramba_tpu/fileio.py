@@ -253,6 +253,26 @@ def _save_rtd(path: str, arr) -> None:
     v = arr._value()
     os.makedirs(path, exist_ok=True)
     pid = jax.process_index()
+    try:
+        _write_rtd_part(path, v, pid)
+    finally:
+        if jax.process_count() > 1:
+            # every process must see every part before anyone may load —
+            # without this barrier a fast rank reads a slow rank's
+            # manifest mid-write (observed as a JSONDecodeError under the
+            # 2-process leg).  finally: a rank whose write FAILED must
+            # still join, or the others block forever.
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("ramba_tpu_rtd_save")
+
+
+def _write_rtd_part(path: str, v, pid: int) -> None:
+    import glob
+    import json
+
+    import jax
+
     # clear THIS process's stale files from any earlier save (other
     # processes own — and clear — their own; saves with a different
     # process count are caught at load time via the recorded nproc)
@@ -291,12 +311,44 @@ def _save_rtd(path: str, arr) -> None:
         entries.append({"file": fname,
                         "start": [lo for lo, _ in b],
                         "stop": [hi for _, hi in b]})
-    with open(os.path.join(path, f"manifest.p{pid}.json"), "w") as f:
+    # atomic manifest publish (tmp + rename): a reader never sees a
+    # half-written part
+    mpath = os.path.join(path, f"manifest.p{pid}.json")
+    with open(mpath + ".tmp", "w") as f:
         json.dump(
             {"shape": list(v.shape), "dtype": np.dtype(v.dtype).name,
              "nproc": jax.process_count(), "shards": entries},
             f,
         )
+    os.replace(mpath + ".tmp", mpath)
+
+
+def _boxes_cover(shape, boxes) -> bool:
+    """Exact union-coverage test for axis-aligned boxes via coordinate
+    compression: cell count is bounded by (2 * nshards)^ndim, independent
+    of the array size, so this runs at load time even for huge arrays."""
+    nd = len(shape)
+    coords = []
+    for d in range(nd):
+        cs = {0, shape[d]}
+        for start, stop in boxes:
+            cs.add(min(max(start[d], 0), shape[d]))
+            cs.add(min(max(stop[d], 0), shape[d]))
+        coords.append(sorted(cs))
+    grid_shape = tuple(max(1, len(c) - 1) for c in coords)
+    covered = np.zeros(grid_shape, bool)
+    import bisect
+
+    for start, stop in boxes:
+        idx = tuple(
+            slice(bisect.bisect_left(coords[d], min(max(start[d], 0),
+                                                    shape[d])),
+                  bisect.bisect_left(coords[d], min(max(stop[d], 0),
+                                                    shape[d])))
+            for d in range(nd)
+        )
+        covered[idx] = True
+    return bool(covered.all())
 
 
 def _load_rtd(path: str, key=None) -> ndarray:
@@ -328,6 +380,27 @@ def _load_rtd(path: str, key=None) -> ndarray:
             f".rtd checkpoint {path!r} was written by {nproc} processes "
             f"but {len(parts)} manifest parts are present — stale or "
             f"incomplete save"
+        )
+    # Validate every shard file upfront (cheap stat per shard): under
+    # multi-controller execution each process reads only the shards its
+    # local devices need, so a read-time FileNotFoundError would fire on
+    # SOME ranks and deadlock the rest at the next collective — this check
+    # fails identically everywhere.
+    missing = [f for _s, _t, f in shards if not os.path.exists(f)]
+    if missing:
+        raise FileNotFoundError(
+            f"rtd checkpoint {path!r} is missing {len(missing)} shard "
+            f"file(s), e.g. {missing[0]!r} — incomplete or corrupted save"
+        )
+    # Upfront whole-array coverage check, for the same reason: a gap only
+    # surfaces on the rank whose region touches it, so a read-time error
+    # would diverge across ranks.
+    if shape != () and 0 not in shape and not _boxes_cover(
+        shape, [(s, t) for s, t, _f in shards]
+    ):
+        raise ValueError(
+            f"rtd checkpoint {path!r} does not cover the full "
+            f"{shape} array — incomplete save?"
         )
 
     mmaps: dict = {}  # one open per shard file per load, not per region
@@ -377,6 +450,26 @@ def _load_rtd(path: str, key=None) -> ndarray:
 register_loader(["rtd"], _load_rtd)
 
 
+def _driver_write_barrier(write_fn) -> None:
+    """Single-writer multi-controller file write: rank 0 writes, then a
+    cross-process barrier so no rank reads an incomplete file.  Every
+    process must call this (SPMD lockstep) — the barrier is collective."""
+    import jax
+
+    if jax.process_count() > 1:
+        try:
+            if jax.process_index() == 0:
+                write_fn()
+        finally:
+            # the barrier must run even when the write fails, or every
+            # other rank blocks in it forever (they can't see the error)
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("ramba_tpu_file_write")
+    else:
+        write_fn()
+
+
 def save(path: str, arr) -> None:
     """Chunked save, dispatched by extension like ``load`` (the reference
     has no save path at all — SURVEY §5 notes this gap).  Distributed
@@ -389,16 +482,32 @@ def save(path: str, arr) -> None:
         # sharded directory format: multi-controller safe (each process
         # writes only its own shards + manifest part)
         return _save_rtd(path, arr)
-    if jax.process_count() > 1:
-        # multi-controller single-file save: each process sees only its
-        # own shards, and every process would truncate the same file.
-        # Refuse BEFORE any file is created/truncated so an existing file
-        # survives.
-        raise NotImplementedError(
-            "single-file save() under multi-controller execution is not "
-            "supported: use the sharded directory format (save to a "
-            "'.rtd' path) or gather to the driver first"
+    if ext not in ("npy", "h5", "hdf5"):
+        raise ValueError(
+            f"no saver for extension {ext!r} (supported: npy, h5/hdf5, rtd)"
         )
+    if jax.process_count() > 1:
+        # Multi-controller single-file save: one all-gather assembles the
+        # array on every process, the DRIVER rank alone writes the file,
+        # and a cross-process barrier holds everyone until it is complete
+        # — the reference's MPI mode does this same driver assembly+write
+        # over its comm queues.  (The .rtd directory format above stays
+        # fully distributed: each process writes only its own shards.)
+        full = arr.asarray() if hasattr(arr, "asarray") else np.asarray(arr)
+
+        def write():
+            if ext == "npy":
+                np.save(path, full)
+            else:
+                try:
+                    import h5py  # type: ignore
+                except ImportError as e:
+                    raise ImportError("h5py is required for HDF5 saving") from e
+                with h5py.File(path, "w") as f:
+                    f.create_dataset("data", data=full)
+
+        _driver_write_barrier(write)
+        return
     shape, dtype = _arr_meta(arr)
     if ext == "npy":
         # open_memmap writes the .npy header then exposes the data region;
@@ -412,7 +521,7 @@ def save(path: str, arr) -> None:
             out.flush()
         finally:
             del out
-    elif ext in ("h5", "hdf5"):
+    else:  # h5/hdf5 — extensions were validated upfront
         try:
             import h5py  # type: ignore
         except ImportError as e:
@@ -424,10 +533,6 @@ def save(path: str, arr) -> None:
                     dset[()] = chunk
                 else:
                     dset[idx] = chunk
-    else:
-        raise ValueError(
-            f"no saver for extension {ext!r} (supported: npy, h5/hdf5)"
-        )
 
 
 def loadtxt(fname, dtype=float, comments="#", delimiter=None, skiprows=0,
@@ -450,5 +555,8 @@ def savetxt(fname, X, fmt="%.18e", delimiter=" ", newline="\n", header="",
             footer="", comments="# "):
     """numpy.savetxt from a distributed array (gathers to host)."""
     x = X.asarray() if hasattr(X, "asarray") else np.asarray(X)
-    np.savetxt(fname, x, fmt=fmt, delimiter=delimiter, newline=newline,
-               header=header, footer=footer, comments=comments)
+    _driver_write_barrier(
+        lambda: np.savetxt(fname, x, fmt=fmt, delimiter=delimiter,
+                           newline=newline, header=header, footer=footer,
+                           comments=comments)
+    )

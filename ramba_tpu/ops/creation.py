@@ -211,10 +211,11 @@ def fromarray(arr, dtype=None, distribution=None):
     a = np.asarray(arr, dtype=dtype)
     sh = _resolve_distribution(distribution, a.shape)
     if sh is not None:
+        from ramba_tpu.core.ndarray import put_sharded
         from ramba_tpu.utils import timing as _timing
 
         _timing.note_transfer("host_to_device", a.nbytes)
-        return ndarray(Const(jax.device_put(a, sh)))
+        return ndarray(Const(put_sharded(a, sh)))
     return ndarray(Const(_device_put_default(a)))
 
 

@@ -32,11 +32,13 @@ def _all_shard_indices(v):
 
 
 def shard_slices(a) -> list:
-    """Per-device global index ranges, one tuple of slices per addressable
-    shard (reference: the per-worker shardview rows size/index_start,
-    shardview_array.py:32-70)."""
+    """Per-device global index ranges, one tuple of slices per shard —
+    EVERY shard, including remote-host ones under multi-controller
+    execution, in mesh device order (reference: the per-worker shardview
+    rows size/index_start, shardview_array.py:32-70; a worker table there
+    covers all workers, not just local ones)."""
     v = _concrete(a)
-    return [s.index for s in v.addressable_shards]
+    return [idx for _dev, idx in _all_shard_indices(v)]
 
 
 def divisions(a) -> np.ndarray:

@@ -344,6 +344,18 @@ def _host_smap(func, slots, with_index, ndim, arrs):
     (ramba.py:1600-1694); the TPU-native equivalent of "just run the Python"
     is a pure_callback: correct for any kernel, but it round-trips through
     the host — rewrite hot kernels with `where` to stay on the MXU/VPU."""
+    if jax.process_count() > 1:
+        # pure_callback cannot consume an array sharded across processes
+        # (no single host sees the data); the reference has no analogue
+        # either — its MPI mode Numba-compiles every kernel, and the
+        # compilable cases are exactly what the branch trace already
+        # lowered on-device before reaching here.
+        raise KernelTraceError(
+            "kernel is not expressible on-device (see previous error) and "
+            "the per-element host fallback is unavailable under "
+            "multi-controller execution; rewrite the kernel with "
+            "np.where/jnp.where/lax.cond"
+        )
     global _host_fallback_warned
     if not _host_fallback_warned:
         _host_fallback_warned = True

@@ -72,12 +72,13 @@ def worker(rank: int, port: int) -> None:
     diff = float(rt.sum((back - big) * (back - big)))
     assert diff == 0.0, diff
 
-    # single-file save must refuse loudly under multi-controller
-    try:
-        rt.save(os.path.join(os.path.dirname(rtd), "nope.npy"), big)
-        raise AssertionError("single-file save should have refused")
-    except NotImplementedError:
-        pass
+    # single-file save under multi-controller: all-gather -> driver rank
+    # writes -> barrier (round-4 verdict #4 follow-on; used to refuse)
+    npy = os.path.join(os.path.dirname(rtd), "single.npy")
+    rt.save(npy, big)
+    back1 = rt.load(npy)
+    diff1 = float(rt.sum((back1 - big) * (back1 - big)))
+    assert diff1 == 0.0, diff1
 
     # the skeleton surface across the process boundary (round 4): a
     # 3-point spmd halo sweep — the ppermute crosses processes — and a

@@ -26,10 +26,18 @@ import os
 # everything on a 2-device mesh; default 8.
 N_DEVICES = int(os.environ.get("RAMBA_TEST_DEVICES", "8"))
 
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "")
-    + f" --xla_force_host_platform_device_count={N_DEVICES}"
-)
+# Cross-process leg (round-4 verdict #4; the reference runs its ENTIRE
+# suite under `mpiexec -n 2`, python-package.yml:40-46): the runner
+# scripts/two_process_suite.py launches this same suite once per rank with
+# RAMBA_TEST_PROCS/RAMBA_TEST_PROC_ID/RAMBA_TEST_COORD set; each rank owns
+# N_DEVICES/PROCS local CPU devices and the global mesh spans both.
+PROCS = int(os.environ.get("RAMBA_TEST_PROCS", "1"))
+
+if PROCS <= 1:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={N_DEVICES}"
+    )
 
 X64 = os.environ.get("RAMBA_TEST_X64", "1") not in ("0", "")
 
@@ -40,6 +48,42 @@ import jax
 # regime — driven by scripts/tpu_test_pass.py, which probes bring-up first.
 if os.environ.get("RAMBA_TEST_TPU", "") in ("1", "true"):
     jax.config.update("jax_enable_x64", False)
+elif PROCS > 1:
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", max(1, N_DEVICES // PROCS))
+    jax.config.update("jax_enable_x64", X64)
+
+    from ramba_tpu.parallel import distributed
+
+    distributed.initialize(
+        coordinator_address=os.environ["RAMBA_TEST_COORD"],
+        num_processes=PROCS,
+        process_id=int(os.environ["RAMBA_TEST_PROC_ID"]),
+    )
+    assert jax.process_count() == PROCS, (
+        f"cross-process leg failed to form the group: "
+        f"process_count={jax.process_count()} != {PROCS}"
+    )
+
+    import hashlib
+    import pathlib
+
+    import pytest
+
+    @pytest.fixture
+    def tmp_path(request):
+        """Rank-SHARED deterministic tmp dir: pytest's stock tmp_path
+        numbers directories per process (rank 0 gets ...0, rank 1 races to
+        ...1), so distributed save/load tests would read paths the driver
+        rank never wrote.  Derive the dir from the test nodeid instead —
+        identical on every rank; single-writer discipline comes from the
+        driver-gated writes in ramba_tpu.fileio."""
+        base = pathlib.Path(os.environ["RAMBA_TEST_SHARED_TMP"])
+        d = base / hashlib.sha1(
+            request.node.nodeid.encode()
+        ).hexdigest()[:16]
+        d.mkdir(parents=True, exist_ok=True)
+        return d
 else:
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", X64)

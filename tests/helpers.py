@@ -62,3 +62,23 @@ def oracle():
     import jax.numpy as jnp
 
     return jnp
+
+
+def local_shard_count() -> int:
+    """Expected number of ADDRESSABLE shards of a default-sharded array:
+    all workers single-controller, this process's slice of them under the
+    cross-process leg (RAMBA_TEST_PROCS)."""
+    import jax
+
+    import ramba_tpu as rt
+
+    return max(1, rt.num_workers() // jax.process_count())
+
+
+def driver_write(fn) -> None:
+    """Run a host-side file write once (driver rank) with a cross-process
+    barrier — for tests that prepare input files by hand.  Single-process:
+    just runs fn."""
+    from ramba_tpu.fileio import _driver_write_barrier
+
+    _driver_write_barrier(fn)

@@ -1026,17 +1026,23 @@ class TestDistributionArgument:
             # (nw, 1): explicit split counts -> realized with whatever mesh
             # axes multiply to nw; P("d0"): raw spec -> d0-way split
             nw = rt.num_workers()
+            from tests.helpers import local_shard_count
+
             for dist, rows in (((nw, 1), n // nw), (P("d0"), n // d0)):
                 a = make(dist)
                 assert a.shape == (n, 8)
                 v = a._value()
-                assert len(v.addressable_shards) == nw
+                # one addressable shard per LOCAL device (the nw global
+                # shards split across processes on the cross-process leg)
+                assert len(v.addressable_shards) == local_shard_count()
                 assert v.addressable_shards[0].data.shape[0] == rows
 
     def test_arange_linspace_distribution(self):
+        from tests.helpers import local_shard_count
+
         nw = rt.num_workers()
         a = rt.arange(4096, distribution=(nw,))
-        assert len(a._value().addressable_shards) == nw
+        assert len(a._value().addressable_shards) == local_shard_count()
         le = rt.linspace(0.0, 1.0, 4096, distribution=(nw,))
         np.testing.assert_allclose(le.asarray(), np.linspace(0.0, 1.0, 4096))
 

@@ -576,8 +576,11 @@ class TestCreationIOBreadth:
         data = np.arange(6.0).reshape(2, 3)
         rt.savetxt(p, rt.fromarray(data))
         _cmp(rt.loadtxt(p), data)
+        from tests.helpers import driver_write
+
         p2 = str(tmp_path / "t2.txt")
-        np.savetxt(p2, data, delimiter=",")
+        # raw numpy write: one writer + barrier on the cross-process leg
+        driver_write(lambda: np.savetxt(p2, data, delimiter=","))
         _cmp(rt.loadtxt(p2, delimiter=","), data)
         _cmp(rt.genfromtxt(p2, delimiter=","), data)
 

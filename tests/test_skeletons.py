@@ -735,8 +735,10 @@ class TestFileIO:
         np.testing.assert_allclose(back.asarray(), v)
 
     def test_dataset_lazy(self, tmp_path):
+        from tests.helpers import driver_write
+
         p = str(tmp_path / "y.npy")
-        np.save(p, np.ones(5))
+        driver_write(lambda: np.save(p, np.ones(5)))
         ds = rt.Dataset(p)
         assert ds.shape == (5,)
         np.testing.assert_allclose((ds[2:] + 1).asarray(), np.full(3, 2.0))
@@ -761,23 +763,30 @@ class TestFileIO:
         h5py = pytest.importorskip("h5py")
         from ramba_tpu import fileio
 
+        from tests.helpers import driver_write, local_shard_count
+
         n = 256
         v = np.random.RandomState(0).rand(n, n)
         p = str(tmp_path / "c.h5")
-        with h5py.File(p, "w") as f:
-            f.create_dataset("data", data=v)
+
+        def prep():
+            with h5py.File(p, "w") as f:
+                f.create_dataset("data", data=v)
+
+        driver_write(prep)  # h5 file locking: exactly one writer
 
         fileio.io_stats.update(chunks=0, max_chunk_bytes=0,
                                whole_array_reads=0)
         back = rt.load(p)
         assert fileio.io_stats["whole_array_reads"] == 0
-        assert fileio.io_stats["chunks"] >= rt.num_workers()
+        # each process reads one chunk per LOCAL shard
+        assert fileio.io_stats["chunks"] >= local_shard_count()
         # bounded host window: each chunk is at most one shard
         assert (fileio.io_stats["max_chunk_bytes"]
                 <= v.nbytes // rt.num_workers() + 8)
         np.testing.assert_allclose(back.asarray(), v)
         # sharded on arrival (no full-array host staging then reshard)
-        assert len(back._value().addressable_shards) == rt.num_workers()
+        assert len(back._value().addressable_shards) == local_shard_count()
 
         # chunked save: written shard-by-shard, reread matches
         fileio.io_stats.update(chunks=0, max_chunk_bytes=0)
@@ -807,8 +816,10 @@ class TestFileIO:
     def test_small_array_single_read(self, tmp_path):
         from ramba_tpu import fileio
 
+        from tests.helpers import driver_write
+
         p = str(tmp_path / "s.npy")
-        np.save(p, np.ones(5))
+        driver_write(lambda: np.save(p, np.ones(5)))
         fileio.io_stats.update(chunks=0, max_chunk_bytes=0,
                                whole_array_reads=0)
         back = rt.load(p)
@@ -1001,8 +1012,10 @@ class TestCheckpoint:
         np.testing.assert_allclose(back["w"].asarray(), w.asarray())
         np.testing.assert_allclose(back["b"].asarray(), b.asarray())
         # sharded on arrival
+        from tests.helpers import local_shard_count
+
         assert (len(back["w"]._value().addressable_shards)
-                == rt.num_workers())
+                == local_shard_count())
 
     def test_restore_into_target_sharding(self, tmp_path):
         pytest.importorskip("orbax.checkpoint")
@@ -1049,9 +1062,11 @@ class TestRtdShardedFormat:
         back = rt.load(p)
         np.testing.assert_allclose(back.asarray(), v)
         # chunked both ways: host window stays at shard size
+        from tests.helpers import local_shard_count
+
         assert (fileio.io_stats["max_chunk_bytes"]
                 <= v.nbytes // rt.num_workers() + 8)
-        assert len(back._value().addressable_shards) == rt.num_workers()
+        assert len(back._value().addressable_shards) == local_shard_count()
 
     def test_reload_region_assembly_across_layouts(self, tmp_path):
         """Saved boxes need not align with the reading layout: force a
@@ -1065,9 +1080,11 @@ class TestRtdShardedFormat:
 
         mesh = _mesh.get_mesh()
         axes = tuple(mesh.axis_names)
+        from ramba_tpu.core.ndarray import put_sharded
+
         v = np.random.RandomState(1).rand(64, 64)
         a = rt.fromarray(v)
-        a.write_expr(Const(jax.device_put(
+        a.write_expr(Const(put_sharded(
             v, NamedSharding(mesh, P(None, axes))
         )))
         p = str(tmp_path / "b.rtd")
@@ -1080,21 +1097,29 @@ class TestRtdShardedFormat:
         import json
         import os
 
+        from tests.helpers import driver_write
+
         v = np.ones((64, 64))
         p = str(tmp_path / "c.rtd")
         rt.save(p, rt.fromarray(v))
+
         # drop one shard from the manifest: load must refuse the
-        # uncovered region, not return zeros
-        mpath = sorted(glob.glob(p + "/manifest.p*.json"))[0]
-        m = json.load(open(mpath))
-        m["shards"] = m["shards"][1:]
-        json.dump(m, open(mpath, "w"))
+        # uncovered region, not return zeros (corruption is done once, by
+        # the driver rank, behind a barrier)
+        def corrupt_manifest():
+            mpath = sorted(glob.glob(p + "/manifest.p*.json"))[0]
+            m = json.load(open(mpath))
+            m["shards"] = m["shards"][1:]
+            json.dump(m, open(mpath, "w"))
+
+        driver_write(corrupt_manifest)
         with pytest.raises(ValueError, match="does not cover"):
             rt.load(p).asarray()
         # a missing shard FILE also refuses (loudly, at read time)
         rt.save(str(tmp_path / "c2.rtd"), rt.fromarray(v))
-        os.remove(sorted(glob.glob(str(tmp_path / "c2.rtd")
-                                   + "/shard_*.npy"))[0])
+        driver_write(lambda: os.remove(
+            sorted(glob.glob(str(tmp_path / "c2.rtd") + "/shard_*.npy"))[0]
+        ))
         with pytest.raises((FileNotFoundError, OSError)):
             rt.load(str(tmp_path / "c2.rtd")).asarray()
 
@@ -1120,11 +1145,21 @@ class TestRtdShardedFormat:
         # refuse at load (the stale-merge hazard of partial overwrites)
         import json
 
+        from tests.helpers import driver_write
+
         p = str(tmp_path / "f.rtd")
         a = rt.fromarray(np.ones((64, 64)))
         rt.save(p, a)
-        with open(p + "/manifest.p7.json", "w") as f:
-            json.dump({"shape": [64, 64], "dtype": str(np.dtype(a.dtype)),
-                       "nproc": 1, "shards": []}, f)
-        with pytest.raises(ValueError, match="manifest parts"):
+
+        def fake_part():
+            with open(p + "/manifest.p7.json", "w") as f:
+                json.dump({"shape": [64, 64],
+                           "dtype": str(np.dtype(a.dtype)),
+                           "nproc": 1, "shards": []}, f)
+
+        driver_write(fake_part)
+        # single-process: part-count mismatch; cross-process leg: the
+        # foreign part's nproc clashes first — both are the refusal
+        with pytest.raises(ValueError,
+                           match="manifest parts|inconsistent .rtd"):
             rt.load(p).asarray()

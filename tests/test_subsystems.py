@@ -310,13 +310,17 @@ class TestJitRemote:
 
 
 class TestDistributed:
-    def test_in_driver_single_host(self):
-        assert rt.distributed.in_driver()
-        assert rt.distributed.process_count() == 1
-        assert rt.distributed.process_index() == 0
+    def test_in_driver_and_process_identity(self):
+        import jax
+
+        # single host: the one process IS the driver; cross-process leg:
+        # exactly rank 0 is (the reference's MPI in_driver gating)
+        assert rt.distributed.in_driver() == (jax.process_index() == 0)
+        assert rt.distributed.process_count() == jax.process_count()
+        assert rt.distributed.process_index() == jax.process_index()
 
     def test_initialize_noop_without_coordinator(self):
-        rt.distributed.initialize()  # must not raise on single host
+        rt.distributed.initialize()  # must not raise when already up/solo
 
     def test_global_mesh(self):
         import jax
@@ -327,7 +331,8 @@ class TestDistributed:
     def test_local_devices(self):
         import jax
 
-        assert len(rt.distributed.local_devices()) == len(jax.devices())
+        assert (len(rt.distributed.local_devices())
+                == len(jax.devices()) // jax.process_count())
 
 
 class TestPersistentCache:
@@ -575,11 +580,15 @@ class TestAdviceBacklogR2:
 class TestMultiProcess:
     """The reference CI's mpiexec -n 2 leg (python-package.yml:40-46), as
     jax multi-controller SPMD.  Spawns two fresh processes, so it is gated
-    behind RAMBA_TPU_MULTIPROC_TEST=1 to keep the default suite fast."""
+    behind RAMBA_TPU_MULTIPROC_TEST=1 to keep the default suite fast.
+    The FULL-suite version of this leg is scripts/two_process_suite.py,
+    which runs every test cross-process (round-4 verdict #4)."""
 
     @pytest.mark.skipif(
         not os.environ.get("RAMBA_TPU_MULTIPROC_TEST"),
-        reason="set RAMBA_TPU_MULTIPROC_TEST=1 to run the 2-process smoke",
+        reason="2-process smoke spawns fresh processes; run via "
+               "RAMBA_TPU_MULTIPROC_TEST=1, or use the full cross-process "
+               "leg: scripts/two_process_suite.py",
     )
     def test_two_process_smoke(self):
         import subprocess
