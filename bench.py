@@ -183,7 +183,11 @@ def _stencil_setup(rt, platform):
             + 0.125 * (a[0, 2] + a[0, -2] + a[2, 0] + a[-2, 0])
         )
 
-    sn = 8192 if platform != "cpu" else 512
+    # Default 8192 (the long-tested shape); the reference's own PRK runs
+    # use 30000^2 (README.md:278) — set RAMBA_BENCH_STENCIL_N=30000 for
+    # the apples-to-apples size (2 x 3.6 GB f32 buffers, fits 16 GB HBM).
+    sn = int(os.environ.get("RAMBA_BENCH_STENCIL_N",
+                            "8192" if platform != "cpu" else "512"))
     x = rt.fromarray(np.random.RandomState(0).rand(sn, sn).astype(np.float32))
     rt.sync()
     return star2, sn, x

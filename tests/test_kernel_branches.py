@@ -201,13 +201,15 @@ def test_stencil_branching_runs_on_device():
             return v
         return a[0, -1]
 
+    from tests.helpers import default_rtol
+
     x = np.random.RandomState(4).randn(16, 16)
     got = np.asarray(rt.sstencil(pick, rt.fromarray(x)))
     right = np.roll(x, -1, axis=1)
     left = np.roll(x, 1, axis=1)
     want = np.where(right > 0, right, left)
     want[:, 0] = want[:, -1] = 0.0  # border zeroing, both offsets depth 1
-    np.testing.assert_allclose(got, want, rtol=1e-12)
+    np.testing.assert_allclose(got, want, rtol=default_rtol(1e-12))
 
 
 def test_scumulative_branching_runs_on_device():

@@ -312,11 +312,14 @@ class TestReductionWhereInitial:
         self.m = rng.rand(6, 7) > 0.4
 
     def _both(self, fn, np_fn, **kw):
+        from tests.helpers import default_rtol
+
         a = rt.fromarray(self.v)
         for axis in (None, 0, 1):
             got = fn(a, axis=axis, **kw)
             want = np_fn(self.v, axis=axis, **kw)
-            np.testing.assert_allclose(np.asarray(got), want, rtol=1e-12)
+            np.testing.assert_allclose(np.asarray(got), want,
+                                       rtol=default_rtol(1e-12))
 
     def test_sum_where_initial(self):
         self._both(rt.sum, np.sum, where=self.m)
