@@ -59,14 +59,20 @@ def _fold_initial(name, r, initial):
     """NumPy folds ``initial`` into the total exactly once."""
     from ramba_tpu.ops import elementwise as ew
 
-    if name == "sum":
+    if name in ("sum", "nansum"):
         return r + initial
-    if name == "prod":
+    if name in ("prod", "nanprod"):
         return r * initial
     if name in ("min", "amin"):
         return ew.minimum(r, initial)
     if name in ("max", "amax"):
         return ew.maximum(r, initial)
+    # nan variants fold NaN-ignoring: an all-NaN slice reduces to NaN and
+    # numpy's nanmin(..., initial=5.0) still returns 5.0
+    if name == "nanmin":
+        return ew.fmin(r, initial)
+    if name == "nanmax":
+        return ew.fmax(r, initial)
     raise TypeError(f"reduction '{name}' does not support initial=")
 
 
@@ -78,7 +84,8 @@ def _red(name, a, axis=None, keepdims=False, dtype=None, out=None, ddof=None,
     if initial is _NO_VALUE:
         initial = None
     if where is not None:
-        if name in ("min", "max", "amin", "amax") and initial is None:
+        if (name in ("min", "max", "amin", "amax", "nanmin", "nanmax")
+                and initial is None):
             # numpy: min/max have no identity, so where= requires initial=
             raise ValueError(
                 f"reduction operation '{name}' does not have an identity, "
@@ -192,20 +199,28 @@ def argmax(a, axis=None, out=None, *, keepdims=False):
     return _red("argmax", a, axis, keepdims, None, out)
 
 
-def nansum(a, axis=None, dtype=None, out=None, *, keepdims=False):
-    return _red("nansum", a, axis, keepdims, dtype, out)
+def nansum(a, axis=None, dtype=None, out=None, *, keepdims=False,
+           where=None, initial=None):
+    return _red("nansum", a, axis, keepdims, dtype, out,
+                where=where, initial=initial)
 
 
-def nanprod(a, axis=None, dtype=None, out=None, *, keepdims=False):
-    return _red("nanprod", a, axis, keepdims, dtype, out)
+def nanprod(a, axis=None, dtype=None, out=None, *, keepdims=False,
+            where=None, initial=None):
+    return _red("nanprod", a, axis, keepdims, dtype, out,
+                where=where, initial=initial)
 
 
-def nanmin(a, axis=None, out=None, *, keepdims=False):
-    return _red("nanmin", a, axis, keepdims, None, out)
+def nanmin(a, axis=None, out=None, *, keepdims=False, where=None,
+           initial=None):
+    return _red("nanmin", a, axis, keepdims, None, out,
+                where=where, initial=initial)
 
 
-def nanmax(a, axis=None, out=None, *, keepdims=False):
-    return _red("nanmax", a, axis, keepdims, None, out)
+def nanmax(a, axis=None, out=None, *, keepdims=False, where=None,
+           initial=None):
+    return _red("nanmax", a, axis, keepdims, None, out,
+                where=where, initial=initial)
 
 
 def nanmean(a, axis=None, dtype=None, out=None, *, keepdims=False):

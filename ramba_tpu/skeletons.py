@@ -265,6 +265,15 @@ def _install_kval_ops():
 _install_kval_ops()
 
 
+def _is_truth_ambiguous(e: BaseException) -> bool:
+    """True only for numpy/jnp's non-scalar bool() error ('The truth value
+    of an array ... is ambiguous') — requiring BOTH phrases keeps user
+    kernels' own ValueErrors (which could contain either word) surfacing
+    from their original call instead of a confusing branch-trace rerun."""
+    s = str(e)
+    return "truth value" in s and "ambiguous" in s
+
+
 def _kwrap(vals):
     def wrap(v):
         if isinstance(v, tuple):  # e.g. smap_index's index tuple
@@ -289,7 +298,14 @@ def _call_kernel(func, *vals):
     try:
         return _unwrap(func(*vals))
     except jax.errors.TracerBoolConversionError:
-        branched = True  # branch on a raw tracer: enumerate below
+        branched = True  # branch on a raw traced scalar: enumerate below
+    except ValueError as e:
+        # non-scalar operands (e.g. _tree_reduce's vector halves) raise
+        # "truth value ... ambiguous" on a data branch; other ValueErrors
+        # are kernel bugs and must surface from the original call
+        if not _is_truth_ambiguous(e):
+            raise
+        branched = True
     except (jax.errors.TracerArrayConversionError, TypeError):
         try:
             return _unwrap(func(*_kwrap(vals)))
@@ -510,13 +526,32 @@ class SreduceReducer:
         self.driver_reducer = driver_reducer
 
 
+def _tree_reduce(flat, identity, comb):
+    """Fold-halves log₂ tree reduce.  Unlike ``lax.reduce``, the combine
+    is an ordinary vectorized elementwise op, so arbitrary kernels work —
+    including branch-lowered select() combines, which XLA:CPU's reduce
+    emitter rejects ("Unsupported reduction computation").  This is also
+    literally the reference's reduction shape: its workers combine
+    partials over a log₂ message tree (ramba.py:2296-2331)."""
+    n = flat.shape[0]
+    size = 1 << max(0, int(n - 1).bit_length())
+    if size != n:
+        flat = jnp.concatenate(
+            [flat, jnp.full((size - n,), identity, flat.dtype)]
+        )
+    while flat.shape[0] > 1:
+        half = flat.shape[0] // 2
+        flat = comb(flat[:half], flat[half:])
+    return flat[0]
+
+
 @defop("sreduce")
 def _op_sreduce(static, mapped):
     local_fn, global_fn, identity, use_shard_split = static
     if not use_shard_split:
         flat = mapped.reshape(-1)
-        return jax.lax.reduce(flat, jnp.asarray(identity, flat.dtype),
-                              lambda a, b: _call_kernel(local_fn, a, b), (0,))
+        return _tree_reduce(flat, jnp.asarray(identity, flat.dtype),
+                            lambda a, b: _call_kernel(local_fn, a, b))
 
     # SreduceReducer path: per-shard reduce with the worker reducer inside
     # shard_map, then combine the per-shard partials with the driver reducer
@@ -532,16 +567,16 @@ def _op_sreduce(static, mapped):
         )
 
     def local(block):
-        r = jax.lax.reduce(block, jnp.asarray(identity, block.dtype),
-                           lambda a, b: _call_kernel(local_fn, a, b), (0,))
+        r = _tree_reduce(block, jnp.asarray(identity, block.dtype),
+                         lambda a, b: _call_kernel(local_fn, a, b))
         return r[None]
 
     partials = jax.shard_map(
         local, mesh=mesh, in_specs=P(axes), out_specs=P(axes),
         check_vma=False,
     )(flat)
-    return jax.lax.reduce(partials, jnp.asarray(identity, partials.dtype),
-                          lambda a, b: _call_kernel(global_fn, a, b), (0,))
+    return _tree_reduce(partials, jnp.asarray(identity, partials.dtype),
+                        lambda a, b: _call_kernel(global_fn, a, b))
 
 
 def _sreduce_impl(func, reducer, identity, arr, args, with_index):
@@ -747,7 +782,7 @@ def call_stencil_body(func, build_args):
         # non-scalar slices (traced or concrete) raise "The truth value of
         # an array ... is ambiguous" on a data branch; any OTHER ValueError
         # is a genuine kernel bug and must surface from the original call
-        if "truth value" not in str(e) and "ambiguous" not in str(e):
+        if not _is_truth_ambiguous(e):
             raise
     except (jax.errors.TracerArrayConversionError, TypeError):
         try:

@@ -177,6 +177,21 @@ def test_host_fallback_refuses_multiprocess():
         np.asarray(rt.smap(countdown, [2.5, -1.0]))
 
 
+def test_sreduce_branching_large_sharded():
+    # regression: XLA:CPU's reduce emitter rejects select-based reducer
+    # computations ("Unsupported reduction computation") at sharded sizes;
+    # the fold-halves tree reduce must handle a branch-lowered combine on
+    # a distributed operand
+    v = np.linspace(-3.0, 3.0, 100_000)
+    best = rt.sreduce(
+        lambda x: x,
+        lambda a, b: a if a > b else b,
+        -np.inf,
+        rt.fromarray(v),
+    )
+    assert float(best) == pytest.approx(v.max())
+
+
 def test_sreduce_branching_runs_on_device():
     # round 4 raised loudly here; the branch trace lowers the reducer
     got = float(
@@ -250,13 +265,13 @@ def test_branch_lowering_beats_host_fallback():
     # next to the host path's per-element Python loop; completion is
     # block_until_ready (the host gather would otherwise dominate the
     # device timing and hide the compute gap being measured)
-    n = 2_000_000
+    n = 3_000_000
     x = np.linspace(-1, 1, n)
     arr = rt.fromarray(x)
 
-    def best_of(n, f):
+    def best_of(reps, f):
         times = []
-        for _ in range(n):
+        for _ in range(reps):
             t0 = time.perf_counter()
             f()
             times.append(time.perf_counter() - t0)
@@ -264,7 +279,7 @@ def test_branch_lowering_beats_host_fallback():
 
     jax.block_until_ready(rt.smap(k, arr)._value())  # compile
     device_s = best_of(
-        3, lambda: jax.block_until_ready(rt.smap(k, arr)._value())
+        5, lambda: jax.block_until_ready(rt.smap(k, arr)._value())
     )
 
     jarr = arr._value()

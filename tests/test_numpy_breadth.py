@@ -373,6 +373,30 @@ class TestReductionWhereInitial:
     def test_mean_where(self):
         self._both(rt.mean, np.mean, where=self.m)
 
+    def test_nan_reductions_where_initial(self):
+        from tests.helpers import default_rtol
+
+        v = self.v.copy()
+        v[0, 0] = v[3, 4] = np.nan
+        a = rt.fromarray(v)
+        for rt_fn, np_fn, kw in (
+            (rt.nansum, np.nansum, {"where": self.m}),
+            (rt.nansum, np.nansum, {"where": self.m, "initial": 2.5}),
+            (rt.nanprod, np.nanprod, {"where": self.m}),
+            (rt.nanmin, np.nanmin, {"where": self.m, "initial": 50.0}),
+            (rt.nanmax, np.nanmax, {"where": self.m, "initial": -50.0}),
+        ):
+            got = rt_fn(a, **kw)
+            # the masked-out NaNs sit at where=False positions; numpy
+            # still warns/ignores consistently — compare values
+            want = np_fn(v, **kw)
+            np.testing.assert_allclose(np.asarray(got), want,
+                                       rtol=default_rtol(1e-12))
+        # all-NaN slice with initial=: numpy returns the initial, not NaN
+        nan_all = rt.fromarray(np.full(8, np.nan))
+        assert float(rt.nanmin(nan_all, initial=5.0)) == 5.0
+        assert float(rt.nanmax(nan_all, initial=-5.0)) == -5.0
+
     def test_where_stays_lazy_and_fused(self):
         from ramba_tpu.core import fuser
 
