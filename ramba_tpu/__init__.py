@@ -55,19 +55,20 @@ from ramba_tpu.ops.manipulation import (  # noqa: F401
 )
 from ramba_tpu.ops.extras import (  # noqa: F401
     append, apply_along_axis, apply_over_axes, argpartition, argwhere,
-    around, array_equiv, atleast_3d, bartlett, bincount, blackman,
-    broadcast_arrays, compress, convolve, corrcoef, correlate, cov, cross,
-    delete, diag_indices, diagonal, diff, digitize, divmod, dsplit, ediff1d,
-    extract, fill_diagonal, fix, flatnonzero, fliplr, flipud, frexp,
-    gradient, hamming, hanning, histogram, hsplit, in1d, insert, interp,
-    intersect1d, isin, ix_, kaiser, kron, modf, nan_to_num,
-    nancumprod, nancumsum, nanmedian, nanpercentile, nanquantile, nonzero,
-    partition, percentile, piecewise, place, poly, polyfit, polyval,
-    put_along_axis, putmask, quantile, ravel_multi_index, real_if_close,
-    resize, roots, rot90, row_stack, searchsorted, setdiff1d, setxor1d,
+    around, array_equiv, atleast_3d, bartlett, bincount, blackman, block,
+    broadcast_arrays, compress, convolve, copyto, corrcoef, correlate, cov,
+    cross, delete, diag_indices, diagonal, diff, digitize, divmod, dsplit,
+    ediff1d, extract, fill_diagonal, fix, flatnonzero, fliplr, flipud,
+    frexp, gradient, hamming, hanning, histogram, histogram2d, hsplit,
+    in1d, insert, interp, intersect1d, isin, ix_, kaiser, kron, lexsort,
+    modf, nan_to_num, nancumprod, nancumsum, nanmedian, nanpercentile,
+    nanquantile, nonzero, packbits, partition, percentile, piecewise,
+    place, poly, polyfit, polyval, put_along_axis, putmask, quantile,
+    ravel_multi_index, real_if_close, require, resize, roots, rot90,
+    row_stack, searchsorted, setdiff1d, setxor1d, sort_complex,
     take_along_axis, trapezoid, trapz, tril_indices, tril_indices_from,
     trim_zeros, triu_indices, triu_indices_from, union1d, unique,
-    unravel_index, unwrap, vander, vsplit,
+    unpackbits, unravel_index, unwrap, vander, vsplit,
 )
 from ramba_tpu.ops.linalg import (  # noqa: F401
     dot, einsum, inner, matmul, outer, set_matmul_precision, tensordot,
@@ -257,6 +258,9 @@ def _register_numpy_dispatch():
         "shape", "ndim", "size", "array2string", "array_repr", "array_str",
         "logspace", "geomspace", "ascontiguousarray", "asfortranarray",
         "rollaxis",
+        # round-5 gap closure
+        "histogram2d", "lexsort", "sort_complex", "block", "copyto",
+        "require", "packbits", "unpackbits",
     ]
     for n in names:
         np_fn = getattr(_np, n, None)

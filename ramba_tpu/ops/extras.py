@@ -237,6 +237,104 @@ def histogram(a, bins=10, range=None, weights=None, density=None):
                         density=density)
 
 
+def histogram2d(x, y, bins=10, range=None, weights=None, density=None):
+    w = _host(weights) if weights is not None else None
+    return np.histogram2d(_host(x), _host(y), bins=bins, range=range,
+                          weights=w, density=density)
+
+
+@defop("lexsort")
+def _op_lexsort(static, *keys):
+    (axis,) = static
+    return jnp.lexsort(keys, axis=axis)
+
+
+def lexsort(keys, axis=-1):
+    """Indirect sort over multiple keys (last key is primary) — device-
+    side via jnp.lexsort, lazily fused.  numpy treats a single >=2-D key
+    array as rows-are-keys; a 1-D single array is one key."""
+    if not isinstance(keys, (list, tuple)):
+        # numpy iterates the first axis of a single key array (rows are
+        # keys for 2-D; scalars for 1-D, giving its odd 0-d result)
+        k = asarray(keys)
+        keys = [k[i] for i in range(k.shape[0])]
+    return ndarray(Node("lexsort", (int(axis),),
+                        [as_exprable(asarray(k)) for k in keys]))
+
+
+def sort_complex(a):
+    return _lazy("sort_complex", a)
+
+
+@defop("block")
+def _op_block(static, *arrs):
+    (template,) = static
+
+    def build(t):
+        if isinstance(t, int):
+            return arrs[t]
+        return [build(e) for e in t]
+
+    return jnp.block(build(template))
+
+
+def block(arrays):
+    """numpy.block: assemble from nested lists of blocks — the nesting is
+    a static template with operand slots, the assembly one lazy on-device
+    jnp.block (no host round-trip for distributed blocks)."""
+    operands = []
+
+    def template(x):
+        if isinstance(x, list):
+            return tuple(template(e) for e in x)
+        operands.append(as_exprable(asarray(x)))
+        return len(operands) - 1
+
+    t = template(arrays)
+    return ndarray(Node("block", (t,), operands))
+
+
+def copyto(dst, src, casting="same_kind", where=True):
+    """numpy.copyto onto a framework array: one fused on-device select
+    (the mutator family treatment — no host round-trip)."""
+    if not isinstance(dst, ndarray):
+        return np.copyto(dst, _host(src), casting=casting, where=_host(where)
+                         if not isinstance(where, bool) else where)
+    if isinstance(src, (bool, int, float, complex)) and \
+            not isinstance(src, np.generic):
+        # python scalars are weakly typed (NEP 50): let numpy itself apply
+        # its value-aware scalar casting rules on a 0-d probe
+        np.copyto(np.empty((), dtype=dst.dtype), src, casting=casting)
+    elif not np.can_cast(asarray(src).dtype, dst.dtype, casting=casting):
+        raise TypeError(
+            f"Cannot cast array data from {asarray(src).dtype} to "
+            f"{dst.dtype} according to the rule '{casting}'"
+        )
+    s = _as_storage_dtype(src, dst.dtype).broadcast_to(dst.shape)
+    if where is True:
+        dst[...] = s
+        return None
+    from ramba_tpu.ops.elementwise import where as _where
+
+    dst[...] = _where(asarray(where), s, dst)
+
+
+def require(a, dtype=None, requirements=None):
+    """numpy.require: layout flags (C/F/ALIGNED/...) are meaningless for
+    device arrays — only the dtype request applies."""
+    a = asarray(a)
+    return a.astype(dtype) if dtype is not None else a
+
+
+def packbits(a, axis=None, bitorder="big"):
+    return np.packbits(_host(a), axis=axis, bitorder=bitorder)
+
+
+def unpackbits(a, axis=None, count=None, bitorder="big"):
+    return np.unpackbits(_host(a), axis=axis, count=count,
+                         bitorder=bitorder)
+
+
 def modf(x):
     """numpy.modf: (fractional, integral) parts, both with x's sign."""
     x = asarray(x)

@@ -408,6 +408,85 @@ class TestReductionWhereInitial:
         assert fuser.stats["flushes"] - before["flushes"] == 1
 
 
+class TestRound5GapClosure:
+    """histogram2d / lexsort / sort_complex / block / copyto / require /
+    packbits round out the drop-in surface (round-5 audit)."""
+
+    def test_histogram2d(self):
+        rng = np.random.RandomState(13)
+        x, y = rng.rand(500), rng.rand(500)
+        got_h, got_xe, got_ye = rt.histogram2d(rt.fromarray(x),
+                                               rt.fromarray(y), bins=5)
+        want_h, want_xe, want_ye = np.histogram2d(x, y, bins=5)
+        np.testing.assert_array_equal(got_h, want_h)
+        np.testing.assert_allclose(got_xe, want_xe)
+        np.testing.assert_allclose(got_ye, want_ye)
+
+    def test_lexsort(self):
+        a = np.array([1, 5, 1, 4, 3, 4, 4])
+        b = np.array([9, 4, 0, 4, 0, 2, 1])
+        got = np.asarray(rt.lexsort((rt.fromarray(b), rt.fromarray(a))))
+        np.testing.assert_array_equal(got, np.lexsort((b, a)))
+        # single 2-D key array: numpy treats the ROWS as separate keys
+        m2 = np.array([[3, 1, 2], [1, 5, 1]])
+        np.testing.assert_array_equal(
+            np.asarray(rt.lexsort(rt.fromarray(m2))), np.lexsort(m2))
+
+    def test_copyto_weak_python_scalars(self):
+        # NEP 50: python int into f32 is fine under casting='safe'; a
+        # python float into int32 is rejected like numpy
+        a = rt.fromarray(np.zeros(3, np.float32))
+        rt.copyto(a, 1, casting="safe")
+        assert np.asarray(a).tolist() == [1.0, 1.0, 1.0]
+        with pytest.raises(TypeError):
+            rt.copyto(rt.fromarray(np.zeros(3, np.int32)), 1.5,
+                      casting="same_kind")
+
+    def test_sort_complex(self):
+        v = np.array([3 + 2j, 1 - 1j, 1 + 3j, 2.0])
+        np.testing.assert_allclose(
+            np.asarray(rt.sort_complex(rt.fromarray(v))),
+            np.sort_complex(v))
+
+    def test_block(self):
+        a = rt.fromarray(np.ones((2, 2)))
+        b = rt.fromarray(np.zeros((2, 2)))
+        got = np.asarray(rt.block([[a, b], [b, a]]))
+        want = np.block([[np.ones((2, 2)), np.zeros((2, 2))],
+                         [np.zeros((2, 2)), np.ones((2, 2))]])
+        np.testing.assert_array_equal(got, want)
+
+    def test_copyto_where_stays_on_device(self):
+        from ramba_tpu.utils.timing import comm_stats
+
+        v = np.random.RandomState(14).rand(256, 256).astype(np.float32)
+        w = v.copy()
+        a = rt.fromarray(v)
+        rt.sync()
+        before = comm_stats["device_to_host_bytes"]
+        mask = w > 0.5
+        rt.copyto(a, np.float32(7.0), where=mask)
+        np.copyto(w, np.float32(7.0), where=mask)
+        rt.sync()
+        assert comm_stats["device_to_host_bytes"] == before
+        np.testing.assert_array_equal(np.asarray(a), w)
+        with pytest.raises(TypeError, match="Cannot cast"):
+            # complex -> float is unsafe in BOTH numerics regimes (the x32
+            # leg truncates f64 to f32, which would equal dst's dtype)
+            rt.copyto(a, np.array([1 + 2j]), casting="safe")
+
+    def test_require_and_packbits(self):
+        a = rt.fromarray(np.arange(6.0))
+        r = rt.require(a, dtype=np.float32)
+        assert np.asarray(r).dtype == np.float32
+        bits = np.array([1, 0, 1, 1, 0, 0, 1, 0], np.uint8)
+        np.testing.assert_array_equal(
+            rt.packbits(rt.fromarray(bits)), np.packbits(bits))
+        packed = np.packbits(bits)
+        np.testing.assert_array_equal(
+            rt.unpackbits(rt.fromarray(packed)), np.unpackbits(packed))
+
+
 class TestNumpyDispatch:
     def test_np_namespace_routes_to_framework(self):
         # np.<fn>(rt_array) must dispatch through __array_function__ for the
