@@ -34,8 +34,9 @@ from ramba_tpu.ops.creation import (  # noqa: F401
     arange, array, asarray, asarray_chkfinite, ascontiguousarray,
     asfortranarray, copy, create_array_with_divisions, empty, empty_like,
     eye, frombuffer, fromarray, fromfunction, fromiter, fromstring, full,
-    full_like, geomspace, identity, indices, init_array, linspace, logspace,
-    meshgrid, mgrid, ones, ones_like, rollaxis, tri, zeros, zeros_like,
+    c_, full_like, geomspace, identity, indices, init_array, linspace,
+    logspace, meshgrid, mgrid, ogrid, ones, ones_like, r_, rollaxis, tri,
+    zeros, zeros_like,
 )
 from ramba_tpu.core.interop import implements, isscalar, result_type  # noqa: F401
 from ramba_tpu.ops.elementwise import *  # noqa: F401,F403

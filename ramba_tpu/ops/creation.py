@@ -295,29 +295,40 @@ def meshgrid(*xi, indexing="xy"):
     return outs
 
 
+def _grid_axis(s):
+    """Parse one mgrid/ogrid slice into (n, start, step, is_float).
+    A complex step means numpy's linspace form: ``0:1:5j`` -> 5 points
+    from 0 to 1 inclusive."""
+    start = s.start or 0
+    stop = s.stop
+    step = s.step if s.step is not None else 1
+    if isinstance(step, complex):
+        n = int(abs(step))
+        st = (stop - start) / (n - 1) if n > 1 else 0.0
+        return n, float(start), float(st), True
+    if step == 0:
+        raise ValueError("slice step cannot be zero")
+    n = int(max(0, -(-(stop - start) // step)))
+    is_float = any(isinstance(v, float) for v in (start, stop, step))
+    return n, start, step, is_float
+
+
 class _MGrid:
-    """np.mgrid equivalent (reference: mgrid, ramba.py:8952-9047 area)."""
+    """np.mgrid equivalent (reference: mgrid, ramba.py:8952-9047 area),
+    including the complex-step linspace form."""
 
     def __getitem__(self, key):
         if not isinstance(key, tuple):
             key = (key,)
-        shape = []
-        starts = []
-        for s in key:
-            start = s.start or 0
-            stop = s.stop
-            step = s.step or 1
-            shape.append(int(max(0, -(-(stop - start) // step))))
-            starts.append((start, step))
-        shape = tuple(shape)
+        axes = [_grid_axis(s) for s in key]
+        shape = tuple(a[0] for a in axes)
+        dtype = float if any(a[3] for a in axes) else int
         outs = []
-        for d in range(len(shape)):
-            start, step = starts[d]
-
+        for d, (_n, start, step, _f) in enumerate(axes):
             def f(*idx, _d=d, _s=start, _st=step):
                 return idx[_d] * _st + _s
 
-            outs.append(fromfunction(f, shape, dtype=int))
+            outs.append(fromfunction(f, shape, dtype=dtype))
         if len(outs) == 1:
             return outs[0]
         from ramba_tpu.ops.manipulation import stack
@@ -326,6 +337,56 @@ class _MGrid:
 
 
 mgrid = _MGrid()
+
+
+class _OGrid:
+    """np.ogrid: open grids — one 1-D (broadcastable) axis array per
+    slice (the reference lists ogrid alongside mgrid, ramba.py:8950)."""
+
+    def __getitem__(self, key):
+        single = not isinstance(key, tuple)
+        if single:
+            key = (key,)
+        outs = []
+        nd = len(key)
+        for d, s in enumerate(key):
+            n, start, step, is_float = _grid_axis(s)
+            if is_float:
+                ax = linspace(start, start + step * max(n - 1, 0), n)
+            else:
+                ax = arange(start, start + n * step, step)
+            shape = [1] * nd
+            shape[d] = n
+            outs.append(ax.reshape(tuple(shape)))
+        return outs[0] if single else outs
+
+
+ogrid = _OGrid()
+
+
+class _RConcat:
+    """np.r_ / np.c_ index-expression concatenators.  These are host-side
+    expression builders by nature (slices, string directives); the
+    assembled result is distributed on arrival."""
+
+    def __init__(self, axis_default):
+        self._np = np.r_ if axis_default == 0 else np.c_
+
+    def __getitem__(self, key):
+        from ramba_tpu.core.ndarray import ndarray as _nd
+
+        def conv(x):
+            return x.asarray() if isinstance(x, _nd) else x
+
+        if isinstance(key, tuple):
+            key = tuple(conv(k) for k in key)
+        else:
+            key = conv(key)
+        return fromarray(self._np[key])
+
+
+r_ = _RConcat(0)
+c_ = _RConcat(1)
 
 
 def indices(dimensions, dtype=int):

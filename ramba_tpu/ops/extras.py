@@ -231,13 +231,14 @@ def delete(arr, obj, axis=None):
     return np.delete(_host(arr), obj, axis=axis)
 
 
-def histogram(a, bins=10, range=None, weights=None, density=None):
+def histogram(a, bins=10, range=None, density=None, weights=None):
+    # positional order matches numpy: (a, bins, range, density, weights)
     w = _host(weights) if weights is not None else None
     return np.histogram(_host(a), bins=bins, range=range, weights=w,
                         density=density)
 
 
-def histogram2d(x, y, bins=10, range=None, weights=None, density=None):
+def histogram2d(x, y, bins=10, range=None, density=None, weights=None):
     w = _host(weights) if weights is not None else None
     return np.histogram2d(_host(x), _host(y), bins=bins, range=range,
                           weights=w, density=density)
@@ -305,12 +306,15 @@ def copyto(dst, src, casting="same_kind", where=True):
         # python scalars are weakly typed (NEP 50): let numpy itself apply
         # its value-aware scalar casting rules on a 0-d probe
         np.copyto(np.empty((), dtype=dst.dtype), src, casting=casting)
-    elif not np.can_cast(asarray(src).dtype, dst.dtype, casting=casting):
-        raise TypeError(
-            f"Cannot cast array data from {asarray(src).dtype} to "
-            f"{dst.dtype} according to the rule '{casting}'"
-        )
-    s = _as_storage_dtype(src, dst.dtype).broadcast_to(dst.shape)
+        src_arr = asarray(src)
+    else:
+        src_arr = asarray(src)  # hoisted: one upload, reused below
+        if not np.can_cast(src_arr.dtype, dst.dtype, casting=casting):
+            raise TypeError(
+                f"Cannot cast array data from {src_arr.dtype} to "
+                f"{dst.dtype} according to the rule '{casting}'"
+            )
+    s = src_arr.astype(dst.dtype).broadcast_to(dst.shape)
     if where is True:
         dst[...] = s
         return None

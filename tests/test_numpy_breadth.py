@@ -475,6 +475,38 @@ class TestRound5GapClosure:
             # leg truncates f64 to f32, which would equal dst's dtype)
             rt.copyto(a, np.array([1 + 2j]), casting="safe")
 
+    def test_grid_complex_step_and_positional_hist(self):
+        # numpy's linspace form (complex step) and positional density=
+        np.testing.assert_allclose(np.asarray(rt.ogrid[0:1:5j]),
+                                   np.ogrid[0:1:5j])
+        np.testing.assert_allclose(np.asarray(rt.mgrid[0:1:3j, 0:4]),
+                                   np.mgrid[0:1:3j, 0:4])
+        with pytest.raises(ValueError, match="zero"):
+            rt.ogrid[0:5:0]
+        x = np.random.RandomState(0).rand(200)
+        y = np.random.RandomState(1).rand(200)
+        np.testing.assert_allclose(
+            np.histogram2d(rt.fromarray(x), rt.fromarray(y), 5, None,
+                           True)[0],
+            np.histogram2d(x, y, 5, None, True)[0])
+        np.testing.assert_allclose(
+            np.histogram(rt.fromarray(x), 5, None, True)[0],
+            np.histogram(x, 5, None, True)[0])
+
+    def test_ogrid_r_c(self):
+        o = rt.ogrid[0:4, 0:3]
+        for a, b in zip(o, np.ogrid[0:4, 0:3]):
+            np.testing.assert_array_equal(np.asarray(a), b)
+        np.testing.assert_array_equal(np.asarray(rt.ogrid[1:9:2]),
+                                      np.ogrid[1:9:2])
+        np.testing.assert_array_equal(
+            np.asarray(rt.r_[np.array([1, 2]), 3, 4:7]),
+            np.r_[np.array([1, 2]), 3, 4:7])
+        a = rt.fromarray(np.arange(3.0))
+        np.testing.assert_array_equal(
+            np.asarray(rt.c_[a, a]),
+            np.c_[np.arange(3.0), np.arange(3.0)])
+
     def test_require_and_packbits(self):
         a = rt.fromarray(np.arange(6.0))
         r = rt.require(a, dtype=np.float32)
