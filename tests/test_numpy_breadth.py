@@ -483,15 +483,19 @@ class TestRound5GapClosure:
                                    np.mgrid[0:1:3j, 0:4])
         with pytest.raises(ValueError, match="zero"):
             rt.ogrid[0:5:0]
+        from tests.helpers import default_rtol
+
         x = np.random.RandomState(0).rand(200)
         y = np.random.RandomState(1).rand(200)
         np.testing.assert_allclose(
             np.histogram2d(rt.fromarray(x), rt.fromarray(y), 5, None,
                            True)[0],
-            np.histogram2d(x, y, 5, None, True)[0])
+            np.histogram2d(x, y, 5, None, True)[0],
+            rtol=default_rtol())
         np.testing.assert_allclose(
             np.histogram(rt.fromarray(x), 5, None, True)[0],
-            np.histogram(x, 5, None, True)[0])
+            np.histogram(x, 5, None, True)[0],
+            rtol=default_rtol())
 
     def test_ogrid_r_c(self):
         o = rt.ogrid[0:4, 0:3]
