@@ -659,10 +659,16 @@ def _op_fromfunction(static, *args):
     idx = [
         jax.lax.broadcasted_iota(jnp.int32, shape, d) for d in range(len(shape))
     ]
+    # _call_kernel gives fromfunction/init_array fillers the same treatment
+    # as skeleton kernels: NumPy-ufunc rerouting and auto-lowered data
+    # branches (the reference Numba-compiles these fillers too,
+    # ramba.py:1535-1595)
+    from ramba_tpu.skeletons import _call_kernel
+
     if with_index:
-        r = fn(*idx, *args) if args else fn(*idx)
+        r = _call_kernel(fn, *idx, *args)
     else:
-        r = fn(*args)
+        r = _call_kernel(fn, *args)
     r = jnp.asarray(r)
     if dtype is not None:
         r = r.astype(jnp.dtype(dtype))

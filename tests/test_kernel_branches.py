@@ -227,6 +227,24 @@ def test_stencil_branching_runs_on_device():
     np.testing.assert_allclose(got, want, rtol=default_rtol(1e-12))
 
 
+def test_fromfunction_and_init_array_branching():
+    # round-5: fillers get the same kernel treatment as skeletons (the
+    # reference Numba-compiles them too, ramba.py:1535-1595)
+    d = rt.fromfunction(lambda i, j: i * 2 if i > j else -j, (4, 4))
+    i, j = np.arange(4)[:, None], np.arange(4)[None, :]
+    np.testing.assert_allclose(
+        np.asarray(d), np.where(i > j, i * 2.0, -j * 1.0))
+    e = rt.init_array(16, lambda k: k * 2 if k % 2 == 0 else -k)
+    np.testing.assert_allclose(
+        np.asarray(e),
+        np.array([k * 2 if k % 2 == 0 else -k for k in range(16)], float))
+    # np.* ufunc rerouting in fillers
+    w = rt.fromfunction(lambda i, j: np.where(i > j, i, -j), (4, 4))
+    np.testing.assert_allclose(
+        np.asarray(w), np.fromfunction(lambda i, j: np.where(i > j, i, -j),
+                                       (4, 4)))
+
+
 def test_scumulative_branching_runs_on_device():
     # small array stays on one shard -> exact sequential semantics
     v = np.ones(16)
