@@ -33,6 +33,20 @@ class MaskedArray(ndarray):
 
     def __init__(self, parent: ndarray, mask: ndarray):
         super().__init__(base=parent, view=_IdentityView())
+        if not isinstance(mask, ndarray):
+            # accept host boolean masks (numpy arrays / lists); NOTE the
+            # polarity is the reference's a[a > 0] SELECTION mask (True =
+            # selected), the inverse of numpy.ma's True = invalid
+            from ramba_tpu.ops.creation import asarray as _as
+
+            mask = _as(mask, dtype=bool)
+        if tuple(mask.shape) != tuple(parent.shape):
+            # a mismatched mask would silently broadcast in the fill but
+            # not in the count, giving wrong statistics — refuse like np.ma
+            raise ValueError(
+                f"mask shape {tuple(mask.shape)} does not match data shape "
+                f"{tuple(parent.shape)}"
+            )
         self._mask = mask
 
     # -- guarded elementwise ---------------------------------------------------

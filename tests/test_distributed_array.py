@@ -219,6 +219,17 @@ class TestBasic:
         npa = np.arange(20) - 10
         assert float(m.mean()) == pytest.approx(float(npa[npa > 0].mean()))
 
+    def test_masked_array_host_mask(self):
+        # round-5: MaskedArray accepts a host numpy selection mask directly
+        # (True = selected, the a[a > 0] polarity — inverse of np.ma)
+        v = np.random.RandomState(7).rand(8, 8)
+        sel = v <= 0.8
+        m = rt.MaskedArray(rt.fromarray(v), mask=sel)
+        ref = np.ma.masked_array(v, mask=~sel)
+        assert float(m.mean()) == pytest.approx(float(ref.mean()))
+        assert float(m.var(ddof=1)) == pytest.approx(float(ref.var(ddof=1)))
+        assert int(m.count()) == int(sel.sum())
+
     def test_masked_var_std_ddof(self):
         # round-3 verdict weak #7: ddof was accepted and silently dropped
         x = np.random.RandomState(3).randn(6, 8)
