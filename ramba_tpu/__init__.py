@@ -45,8 +45,8 @@ from ramba_tpu.ops.elementwise import (  # noqa: F401
 )
 from ramba_tpu.ops.reductions import (  # noqa: F401
     all, amax, amin, any, argmax, argmin, average, count_nonzero, cumprod,
-    cumsum, max, mean, median, min, nanmax, nanmean, nanmin, nanprod, nanstd,
-    nansum, nanvar, prod, ptp, std, sum, var,
+    cumsum, max, mean, median, min, nanargmax, nanargmin, nanmax, nanmean,
+    nanmin, nanprod, nanstd, nansum, nanvar, prod, ptp, std, sum, var,
 )
 from ramba_tpu.ops.manipulation import (  # noqa: F401
     apply_index, argsort, array_split, atleast_1d, atleast_2d, broadcast_to,
@@ -261,7 +261,7 @@ def _register_numpy_dispatch():
         "rollaxis",
         # round-5 gap closure
         "histogram2d", "lexsort", "sort_complex", "block", "copyto",
-        "require", "packbits", "unpackbits",
+        "require", "packbits", "unpackbits", "nanargmin", "nanargmax",
     ]
     for n in names:
         np_fn = getattr(_np, n, None)

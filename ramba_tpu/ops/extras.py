@@ -413,29 +413,29 @@ def _q_arg(q):
     return asarray(np.asarray(q, dtype=float))
 
 
-def quantile(a, q, axis=None, keepdims=False):
-    kw = {"keepdims": bool(keepdims)}
+def quantile(a, q, axis=None, keepdims=False, *, method="linear"):
+    kw = {"keepdims": bool(keepdims), "method": str(method)}
     if axis is not None:
         kw["axis"] = int(axis)
     return _lazy("quantile", a, _q_arg(q), **kw)
 
 
-def percentile(a, q, axis=None, keepdims=False):
-    kw = {"keepdims": bool(keepdims)}
+def percentile(a, q, axis=None, keepdims=False, *, method="linear"):
+    kw = {"keepdims": bool(keepdims), "method": str(method)}
     if axis is not None:
         kw["axis"] = int(axis)
     return _lazy("percentile", a, _q_arg(q), **kw)
 
 
-def nanquantile(a, q, axis=None, keepdims=False):
-    kw = {"keepdims": bool(keepdims)}
+def nanquantile(a, q, axis=None, keepdims=False, *, method="linear"):
+    kw = {"keepdims": bool(keepdims), "method": str(method)}
     if axis is not None:
         kw["axis"] = int(axis)
     return _lazy("nanquantile", a, _q_arg(q), **kw)
 
 
-def nanpercentile(a, q, axis=None, keepdims=False):
-    kw = {"keepdims": bool(keepdims)}
+def nanpercentile(a, q, axis=None, keepdims=False, *, method="linear"):
+    kw = {"keepdims": bool(keepdims), "method": str(method)}
     if axis is not None:
         kw["axis"] = int(axis)
     return _lazy("nanpercentile", a, _q_arg(q), **kw)

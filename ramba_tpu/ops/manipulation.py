@@ -298,11 +298,18 @@ def tile(a, reps):
     return ndarray(Node("tile", (reps,), [a.read_expr()]))
 
 
-def sort(a, axis=-1):
+def sort(a, axis=-1, kind=None, order=None, *, stable=None):
+    # numpy's kind/stable are accepted for signature parity; the XLA sort
+    # is always stable, so every kind is satisfied.  Field `order` needs
+    # structured dtypes, which device arrays don't have.
+    if order is not None:
+        raise ValueError("order= requires structured dtypes (unsupported)")
     return ndarray(Node("sort", (axis,), [as_exprable(asarray(a))]))
 
 
-def argsort(a, axis=-1):
+def argsort(a, axis=-1, kind=None, order=None, *, stable=None):
+    if order is not None:
+        raise ValueError("order= requires structured dtypes (unsupported)")
     return ndarray(Node("argsort", (axis,), [as_exprable(asarray(a))]))
 
 

@@ -199,6 +199,31 @@ def argmax(a, axis=None, out=None, *, keepdims=False):
     return _red("argmax", a, axis, keepdims, None, out)
 
 
+def _check_all_nan_slice(a, axis):
+    """numpy raises for all-NaN slices; jnp.nanarg* would silently return
+    -1 (which then indexes the LAST element — data corruption for ported
+    code).  Parity costs one eager scalar fetch here; nanarg* is rare
+    enough that breaking the lazy chain is the right trade."""
+    from ramba_tpu.ops import elementwise as ew
+
+    a = asarray(a)
+    if np.dtype(a.dtype).kind not in "fc":
+        return
+    allnan = _red("all", ew.isnan(a), axis)
+    if bool(_red("any", allnan)):
+        raise ValueError("All-NaN slice encountered")
+
+
+def nanargmin(a, axis=None, out=None, *, keepdims=False):
+    _check_all_nan_slice(a, axis)
+    return _red("nanargmin", a, axis, keepdims, None, out)
+
+
+def nanargmax(a, axis=None, out=None, *, keepdims=False):
+    _check_all_nan_slice(a, axis)
+    return _red("nanargmax", a, axis, keepdims, None, out)
+
+
 def nansum(a, axis=None, dtype=None, out=None, *, keepdims=False,
            where=None, initial=None):
     return _red("nansum", a, axis, keepdims, dtype, out,

@@ -511,6 +511,39 @@ class TestRound5GapClosure:
             np.asarray(rt.c_[a, a]),
             np.c_[np.arange(3.0), np.arange(3.0)])
 
+    def test_sort_percentile_kwargs_and_nanarg(self):
+        from tests.helpers import default_rtol
+
+        v = np.random.RandomState(15).rand(6, 8)
+        a = rt.fromarray(v)
+        np.testing.assert_allclose(
+            np.asarray(rt.sort(a, axis=1, kind="stable")), np.sort(v, 1))
+        np.testing.assert_array_equal(
+            np.asarray(rt.argsort(a, axis=0, kind="mergesort")),
+            np.argsort(v, 0, kind="stable"))
+        with pytest.raises(ValueError, match="structured"):
+            rt.sort(a, order="f0")
+        for method in ("linear", "lower", "higher", "nearest", "midpoint"):
+            np.testing.assert_allclose(
+                np.asarray(rt.percentile(a, 30, method=method)),
+                np.percentile(v, 30, method=method),
+                rtol=default_rtol(1e-12))
+        vn = v.copy()
+        vn[0, 0] = np.nan
+        an = rt.fromarray(vn)
+        assert int(rt.nanargmin(an)) == np.nanargmin(vn)
+        np.testing.assert_array_equal(
+            np.asarray(rt.nanargmax(an, axis=1)), np.nanargmax(vn, axis=1))
+        # np.* dispatch
+        assert int(np.nanargmin(an)) == np.nanargmin(vn)
+        # all-NaN slice raises like numpy (jnp would return -1 silently)
+        vn2 = v.copy()
+        vn2[2, :] = np.nan
+        with pytest.raises(ValueError, match="All-NaN"):
+            rt.nanargmin(rt.fromarray(vn2), axis=1)
+        with pytest.raises(ValueError, match="All-NaN"):
+            rt.nanargmax(rt.fromarray(np.full(4, np.nan)))
+
     def test_require_and_packbits(self):
         a = rt.fromarray(np.arange(6.0))
         r = rt.require(a, dtype=np.float32)
