@@ -563,10 +563,17 @@ class ndarray:
                 return NotImplemented
             if name == "divide":
                 name = "true_divide"
-            if name not in E.MAPFN:
+            if name == "matmul":
+                # np_array @ rt_array arrives here (numpy defers via the
+                # matmul ufunc, not __rmatmul__)
+                from ramba_tpu.ops.linalg import matmul as _mm
+
+                res = _mm(inputs[0], inputs[1])
+            elif name not in E.MAPFN:
                 return NotImplemented
-            operands = [as_exprable(x) for x in inputs]
-            res = ndarray(E.make_map(name, operands))
+            else:
+                operands = [as_exprable(x) for x in inputs]
+                res = ndarray(E.make_map(name, operands))
         elif method == "reduce":
             ufunc_red = {"add": "sum", "multiply": "prod", "minimum": "min",
                          "maximum": "max", "logical_and": "all",
@@ -587,6 +594,13 @@ class ndarray:
             return NotImplemented
         if out is not None:
             (o,) = out if isinstance(out, tuple) else (out,)
+            if isinstance(o, np.ndarray):
+                # numpy target: materialize and copy back host-side with
+                # numpy's ufunc out= casting contract (same_kind — silent
+                # float->int truncation must raise like numpy does).
+                # (np.add(rt, rt, out=np_buf) and np_buf += rt land here)
+                np.copyto(o, res.asarray(), casting="same_kind")
+                return o
             val = res.read_expr()
             if np.dtype(val.dtype) != o.dtype:
                 val = Node("cast", (str(o.dtype),), [val])

@@ -556,6 +556,27 @@ class TestRound5GapClosure:
             rt.unpackbits(rt.fromarray(packed)), np.unpackbits(packed))
 
 
+class TestUfuncInteropEdges:
+    """numpy-left operands and numpy out= targets (round-5 probes)."""
+
+    def test_numpy_inplace_and_out_targets(self):
+        v = np.random.RandomState(16).rand(16)
+        a = rt.fromarray(v.copy())
+        w = v.copy()
+        w += a  # numpy-left in-place: host copy-back
+        np.testing.assert_allclose(w, v * 2)
+        out = np.zeros(16)
+        r = np.add(a, a, out=out)
+        assert r is out
+        np.testing.assert_allclose(out, v * 2)
+
+    def test_matmul_ufunc_numpy_left(self):
+        m = np.random.RandomState(17).rand(4, 4)
+        am = rt.fromarray(m)
+        np.testing.assert_allclose(np.asarray(m @ am), m @ m, rtol=1e-10)
+        np.testing.assert_allclose(np.asarray(am @ m), m @ m, rtol=1e-10)
+
+
 class TestNumpyDispatch:
     def test_np_namespace_routes_to_framework(self):
         # np.<fn>(rt_array) must dispatch through __array_function__ for the
