@@ -72,8 +72,8 @@ from ramba_tpu.ops.extras import (  # noqa: F401
     unpackbits, unravel_index, unwrap, vander, vsplit,
 )
 from ramba_tpu.ops.linalg import (  # noqa: F401
-    dot, einsum, inner, matmul, outer, set_matmul_precision, tensordot,
-    trace, vdot,
+    dot, einsum, einsum_path, inner, matmul, outer, set_matmul_precision,
+    tensordot, trace, vdot,
 )
 from ramba_tpu.parallel.mesh import (  # noqa: F401
     get_mesh, num_workers, set_mesh,
@@ -262,6 +262,7 @@ def _register_numpy_dispatch():
         # round-5 gap closure
         "histogram2d", "lexsort", "sort_complex", "block", "copyto",
         "require", "packbits", "unpackbits", "nanargmin", "nanargmax",
+        "einsum_path",
     ]
     for n in names:
         np_fn = getattr(_np, n, None)

@@ -143,6 +143,68 @@ def eig(a):
     return np.linalg.eig(_host(a))
 
 
+def multi_dot(arrays, *, out=None):
+    """numpy.linalg.multi_dot: chained matmul in the FLOP-optimal
+    parenthesization.  The order depends only on static shapes (classic
+    matrix-chain DP, numpy's own algorithm); the chain itself is built as
+    lazy on-device matmuls in that order.  1-D end operands get numpy's
+    vector promotion (prepended/appended unit dim, squeezed at the end)."""
+    from ramba_tpu.ops.creation import asarray as _as
+    from ramba_tpu.ops.linalg import matmul as _mm
+
+    arrs = [_as(a) for a in arrays]
+    if len(arrs) < 2:
+        raise ValueError("Expecting at least two arrays.")
+    # numpy's contract: ends may be 1-D or 2-D, interior must be 2-D
+    if arrs[0].ndim not in (1, 2) or arrs[-1].ndim not in (1, 2) or any(
+        a.ndim != 2 for a in arrs[1:-1]
+    ):
+        raise ValueError(
+            "multi_dot only supports 2d arrays (1d at the start/end)"
+        )
+    squeeze_front = arrs[0].ndim == 1
+    squeeze_back = arrs[-1].ndim == 1
+    if squeeze_front:
+        arrs[0] = arrs[0].reshape((1, arrs[0].shape[0]))
+    if squeeze_back:
+        arrs[-1] = arrs[-1].reshape((arrs[-1].shape[0], 1))
+    n = len(arrs)
+    if n == 2:
+        res = _mm(arrs[0], arrs[1])
+    else:
+        dims = [a.shape[0] for a in arrs] + [arrs[-1].shape[1]]
+        cost = [[0] * n for _ in range(n)]
+        split = [[0] * n for _ in range(n)]
+        for ln in range(2, n + 1):
+            for i in range(n - ln + 1):
+                j = i + ln - 1
+                cost[i][j] = float("inf")
+                for k in range(i, j):
+                    c = (cost[i][k] + cost[k + 1][j]
+                         + dims[i] * dims[k + 1] * dims[j + 1])
+                    if c < cost[i][j]:
+                        cost[i][j] = c
+                        split[i][j] = k
+
+        def build(i, j):
+            if i == j:
+                return arrs[i]
+            k = split[i][j]
+            return _mm(build(i, k), build(k + 1, j))
+
+        res = build(0, n - 1)
+    if squeeze_front or squeeze_back:
+        res = res.reshape(tuple(
+            s for d, s in enumerate(res.shape)
+            if not ((d == 0 and squeeze_front)
+                    or (d == res.ndim - 1 and squeeze_back))
+        ) or ())
+    if out is not None:
+        out.write_expr(res.read_expr())
+        return out
+    return res
+
+
 def eigvals(a):
     return np.linalg.eigvals(_host(a))
 

@@ -82,6 +82,32 @@ def einsum(subscripts, *operands):
     )
 
 
+def einsum_path(subscripts, *operands, optimize="greedy"):
+    """numpy.einsum_path: contraction-order analysis.  Depends only on
+    static shapes, so run numpy's planner over zero-byte shape stubs (no
+    device data is ever touched).  Supports both the subscripts-string
+    and the interleaved sublist calling conventions."""
+
+    def stub(o):
+        # index sublists in the interleaved form pass through untouched
+        if isinstance(o, (list, tuple)):
+            return o
+        return np.broadcast_to(
+            np.float64(0), tuple(getattr(o, "shape", np.shape(o)))
+        )
+
+    if isinstance(subscripts, str):
+        return np.einsum_path(subscripts,
+                              *[stub(o) for o in operands],
+                              optimize=optimize)
+    # interleaved form: (op0, list0, op1, list1, ..., [out_list]) — the
+    # first argument is itself an operand; stub it too or the dispatch
+    # recurses back here forever
+    return np.einsum_path(stub(subscripts),
+                          *[stub(o) for o in operands],
+                          optimize=optimize)
+
+
 def trace(a, offset=0, axis1=0, axis2=1):
     """numpy.trace semantics for any rank >= 2 (sum along the matching
     diagonal of the two selected axes; remaining axes stay)."""

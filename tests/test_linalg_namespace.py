@@ -187,3 +187,37 @@ class TestReviewRegressions:
         v = np.array([0.0, 2.0, 0.0])
         assert int(rt.linalg.matrix_rank(rt.fromarray(v), 1e-3)) == 1
         assert int(rt.linalg.matrix_rank(rt.fromarray(np.zeros(3)), 1e-3)) == 0
+
+
+class TestMultiDotEinsumPath:
+    def test_multi_dot_matches_numpy(self):
+        rs = np.random.RandomState(0)
+        A, B, C, D = rs.rand(10, 30), rs.rand(30, 5), rs.rand(5, 60), \
+            rs.rand(60, 8)
+        got = np.asarray(rt.linalg.multi_dot(
+            [rt.fromarray(A), rt.fromarray(B), rt.fromarray(C),
+             rt.fromarray(D)]))
+        np.testing.assert_allclose(got, np.linalg.multi_dot([A, B, C, D]),
+                                   rtol=default_rtol(1e-10))
+
+    def test_multi_dot_vector_ends(self):
+        rs = np.random.RandomState(1)
+        v1, A, B, v2 = rs.rand(10), rs.rand(10, 30), rs.rand(30, 8), \
+            rs.rand(8)
+        got = rt.linalg.multi_dot(
+            [rt.fromarray(v1), rt.fromarray(A), rt.fromarray(B),
+             rt.fromarray(v2)])
+        np.testing.assert_allclose(
+            float(got), np.linalg.multi_dot([v1, A, B, v2]),
+            rtol=default_rtol(1e-10))
+
+    def test_einsum_path_shape_only(self):
+        A = rt.fromarray(np.zeros((8, 4)))
+        B = rt.fromarray(np.zeros((4, 16)))
+        path, _report = rt.einsum_path("ij,jk->ik", A, B)
+        want, _ = np.einsum_path("ij,jk->ik", np.zeros((8, 4)),
+                                 np.zeros((4, 16)))
+        assert path == want
+        # np.* dispatch
+        path2, _ = np.einsum_path("ij,jk->ik", A, B)
+        assert path2 == want

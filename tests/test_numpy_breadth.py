@@ -573,8 +573,10 @@ class TestUfuncInteropEdges:
     def test_matmul_ufunc_numpy_left(self):
         m = np.random.RandomState(17).rand(4, 4)
         am = rt.fromarray(m)
-        np.testing.assert_allclose(np.asarray(m @ am), m @ m, rtol=1e-10)
-        np.testing.assert_allclose(np.asarray(am @ m), m @ m, rtol=1e-10)
+        np.testing.assert_allclose(np.asarray(m @ am), m @ m,
+                                   rtol=default_rtol(1e-10))
+        np.testing.assert_allclose(np.asarray(am @ m), m @ m,
+                                   rtol=default_rtol(1e-10))
 
 
 class TestNumpyDispatch:
