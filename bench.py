@@ -352,6 +352,7 @@ def main():
         "vs_baseline": None,
     }
     try:
+        t_up = time.perf_counter()
         sel = _bring_up(out)
 
         import jax
@@ -361,8 +362,23 @@ def main():
 
         import ramba_tpu as rt
 
-        platform = _devices_with_recovery(jax, out)[0].platform
+        devs = _devices_with_recovery(jax, out)
+        platform = devs[0].platform
         out["platform"] = platform
+        # TPU-health record: bring-up outcome into the event stream (and
+        # this JSON line) so a wedged chip / CPU fallback is attributable
+        # after the fact instead of an opaque tpu_init_error string.
+        from ramba_tpu.observe import health as _health
+
+        out["health"] = _health.record(
+            platform=platform,
+            device_count=len(devs),
+            init_seconds=time.perf_counter() - t_up,
+            outcome="ok" if "tpu_init_error" not in out else "fallback",
+            error=out.get("tpu_init_error"),
+            selected_via=out.get("backend_selected_via"),
+            source="bench_bring_up",
+        )
         n = 1_000_000_000
         if platform == "cpu":  # debug/dry-run environments
             n = 10_000_000

@@ -37,12 +37,25 @@ import jax
 
 from ramba_tpu import common
 from ramba_tpu.core.expr import Const, Expr, Node, Scalar, OPS
+from ramba_tpu.observe import events as _events
+from ramba_tpu.observe import profile as _profile
+from ramba_tpu.observe import registry as _registry
 from ramba_tpu.parallel import mesh as _mesh
 from ramba_tpu.utils import timing as _timing
 
 # Donation is pointless for small buffers and fragments the jit cache (the
 # donate mask is part of the compile key); only donate above this size.
 DONATE_MIN_BYTES = 1 << 20
+
+
+def _nbytes(v) -> int:
+    """Buffer size, 0 when unknowable — extended dtypes (e.g. PRNG key
+    arrays) raise from the ``nbytes`` property itself, so getattr-with-
+    default is not enough."""
+    try:
+        return int(v.nbytes)
+    except Exception:
+        return 0
 
 # ndarrays with a pending (non-Const) expression — the reference keeps the
 # analogous set as DAG nodes ordered by seq_no (ramba.py:4387-4548).
@@ -238,12 +251,14 @@ def _get_compiled(program: _Program, donate_key: tuple):
     key = (program.key, donate_key)
     fn = _compile_cache.get(key)
     if fn is not None:
+        _registry.inc("fuser.cache_hit")
         return fn, False
     if len(_compile_cache) >= _COMPILE_CACHE_MAX:
         _compile_cache.pop(next(iter(_compile_cache)))
     fn = jax.jit(_build_callable(program), donate_argnums=donate_key)
     _compile_cache[key] = fn
     stats["compiles"] += 1
+    _registry.inc("fuser.cache_miss")
     return fn, True
 
 
@@ -298,7 +313,8 @@ def _iter_segments(program: _Program, last_use: dict):
         start = end
 
 
-def _run_segmented(program: _Program, leaf_vals: list, donate_idx: tuple):
+def _run_segmented(program: _Program, leaf_vals: list, donate_idx: tuple,
+                   span: Optional[dict] = None):
     """Execute an oversized program as chained jit calls of at most
     ``common.max_program_instrs`` instructions each.
 
@@ -322,11 +338,11 @@ def _run_segmented(program: _Program, leaf_vals: list, donate_idx: tuple):
                 continue  # still live after this segment
             if s < n_leaves and s not in donate_set:
                 continue  # caller-visible leaf not cleared for donation
-            if getattr(vals[s], "nbytes", 0) >= DONATE_MIN_BYTES:
+            if _nbytes(vals[s]) >= DONATE_MIN_BYTES:
                 seg_donate.append(j)
         fn, is_new = _get_compiled(seg_prog, tuple(seg_donate))
         seg_vals = [vals[s] for s in in_slots]
-        outs = _execute_compiled(fn, seg_prog, seg_vals, is_new)
+        outs = _execute_compiled(fn, seg_prog, seg_vals, is_new, span=span)
         del seg_vals
         for s in in_slots:
             if last_use.get(s, 0) < top:
@@ -334,15 +350,19 @@ def _run_segmented(program: _Program, leaf_vals: list, donate_idx: tuple):
         for s, v in zip(out_here, outs):
             vals[s] = v
         stats["segments"] += 1
+        _registry.inc("fuser.segments")
     return tuple(vals[s] for s in program.out_slots)
 
 
-def _execute_compiled(fn, program: _Program, leaf_vals, is_new: bool):
+def _execute_compiled(fn, program: _Program, leaf_vals, is_new: bool,
+                      span: Optional[dict] = None):
     """Run one compiled program with the shared observability treatment:
     RAMBA_SHOW_CODE dump on first compile, profiler TraceAnnotation at
-    RAMBA_TIMING>=2, and first-call (trace+lower+XLA compile) vs
-    steady-state timing attribution.  Used by both the monolithic and
-    segmented flush paths so the two can never drift."""
+    RAMBA_TIMING>=2 or under RAMBA_PROFILE_DIR, first-call
+    (trace+lower+XLA compile) vs steady-state timing attribution, and —
+    when ``span`` is given — a per-call child record in the flush span.
+    Used by both the monolithic and segmented flush paths so the two can
+    never drift."""
     if is_new and common.show_code:
         import sys
 
@@ -359,12 +379,10 @@ def _execute_compiled(fn, program: _Program, leaf_vals, is_new: bool):
         except Exception:
             pass
     t0 = time.perf_counter()
-    if common.timing_level > 1:
-        # label the dispatch in profiler traces (utils.timing.
-        # profiler_trace); off the hot path unless RAMBA_TIMING>=2
-        import jax.profiler as _prof
-
-        with _prof.TraceAnnotation(_program_label(program)):
+    if common.timing_level > 1 or _profile.enabled():
+        # label the dispatch in profiler traces (RAMBA_PROFILE_DIR /
+        # utils.timing.profiler_trace); off the hot path otherwise
+        with _profile.annotation(_program_label(program)):
             outs = fn(*leaf_vals)
     else:
         outs = fn(*leaf_vals)
@@ -378,6 +396,12 @@ def _execute_compiled(fn, program: _Program, leaf_vals, is_new: bool):
         _timing.add_time("flush_execute", dt)
         if common.timing_level > 0:  # label hashing is off the hot path
             _timing.add_func_time(_program_label(program), dt)
+    if span is not None:
+        span["calls"].append({
+            "label": _program_label(program),
+            "cache": "miss" if is_new else "hit",
+            "seconds": round(dt, 6),
+        })
     return outs
 
 
@@ -392,37 +416,88 @@ def flush(extra: Sequence[Expr] = ()) -> list:
     exprs = [a._expr for a in roots] + list(extra)
     if not exprs:
         return []
+    t_flush = time.perf_counter()
+    rw_before = None
+    if common.rewrite_enabled:
+        from ramba_tpu.core.rewrite import stats as _rw_stats
+
+        rw_before = dict(_rw_stats)
     program, leaves = _prepare_program(exprs)
+    linearize_s = time.perf_counter() - t_flush
+    rewrite_fires = {}
+    if rw_before is not None:
+        from ramba_tpu.core.rewrite import stats as _rw_stats
+
+        rewrite_fires = {
+            k: v - rw_before.get(k, 0)
+            for k, v in _rw_stats.items()
+            if v != rw_before.get(k, 0)
+        }
+    label = _program_label(program)
+    span = {
+        "type": "flush",
+        "label": label,
+        "instrs": len(program.instrs),
+        "n_leaves": program.n_leaves,
+        "n_roots": len(roots),
+        "linearize_s": round(linearize_s, 6),
+        "rewrite_fires": rewrite_fires,
+        "calls": [],
+    }
 
     donate = []
     leaf_vals = []
+    leaf_bytes = 0
     for i, leaf in enumerate(leaves):
         if isinstance(leaf, Const):
             v = leaf.value
             leaf_vals.append(v)
+            leaf_bytes += _nbytes(v)
             if (
-                getattr(v, "nbytes", 0) >= DONATE_MIN_BYTES
+                _nbytes(v) >= DONATE_MIN_BYTES
                 and _const_owners.get(id(v), 0) == 0
             ):
                 donate.append(i)
         else:
             leaf_vals.append(leaf.value)
     donate_key = tuple(donate)
-    with warnings.catch_warnings():
-        warnings.filterwarnings("ignore", message=".*[Dd]onat.*")
-        if (
-            common.max_program_instrs
-            and len(program.instrs) > common.max_program_instrs
-        ):
-            outs = _run_segmented(program, leaf_vals, donate_key)
-        else:
-            fn, is_new = _get_compiled(program, donate_key)
-            outs = _execute_compiled(fn, program, leaf_vals, is_new)
+    span["donated"] = len(donate)
+    span["leaf_bytes"] = leaf_bytes
+    _profile.ensure_started()
+    with _profile.annotation("ramba_flush:" + label):
+        with warnings.catch_warnings():
+            warnings.filterwarnings("ignore", message=".*[Dd]onat.*")
+            if (
+                common.max_program_instrs
+                and len(program.instrs) > common.max_program_instrs
+            ):
+                outs = _run_segmented(program, leaf_vals, donate_key,
+                                      span=span)
+            else:
+                fn, is_new = _get_compiled(program, donate_key)
+                outs = _execute_compiled(fn, program, leaf_vals, is_new,
+                                         span=span)
     stats["flushes"] += 1
     stats["nodes_flushed"] += len(program.instrs)
+    _registry.inc("fuser.flushes")
+    _registry.inc("fuser.nodes_flushed", len(program.instrs))
     del leaf_vals
     for arr, val in zip(roots, outs[: len(roots)]):
         arr._set_expr(Const(val))
+    calls = span["calls"]
+    span["segments"] = len(calls) - 1 if len(calls) > 1 else 0
+    span["compile_s"] = round(
+        sum(c["seconds"] for c in calls if c["cache"] == "miss"), 6
+    )
+    span["execute_s"] = round(
+        sum(c["seconds"] for c in calls if c["cache"] == "hit"), 6
+    )
+    span["cache"] = (
+        "miss" if any(c["cache"] == "miss" for c in calls) else "hit"
+    )
+    span["out_bytes"] = sum(_nbytes(v) for v in outs)
+    span["wall_s"] = round(time.perf_counter() - t_flush, 6)
+    _events.emit(span)
     return list(outs[len(roots):])
 
 

@@ -326,8 +326,10 @@ class ndarray:
         v = self._value()
         if not v.is_fully_addressable:
             from jax.experimental import multihost_utils
+            from ramba_tpu.parallel import distributed as _distributed
 
             out = np.asarray(multihost_utils.process_allgather(v, tiled=True))
+            _distributed.note_transfer("allgather", out.nbytes)
         else:
             out = np.asarray(v)
         _timing.note_transfer("device_to_host", out.nbytes)

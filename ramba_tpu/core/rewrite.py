@@ -26,6 +26,7 @@ from __future__ import annotations
 import numpy as np
 
 from ramba_tpu.core.expr import Const, Expr, Node
+from ramba_tpu.observe import registry as _registry
 
 REDUCE_KINDS = {"mean", "nanmean", "sum", "nansum", "min", "max", "prod"}
 
@@ -363,6 +364,7 @@ def rewrite_roots(roots):
                     r = None
                 if r is not None:
                     stats[rule.__name__] += 1
+                    _registry.inc(f"rewrite.{rule.__name__}")
                     cand = r
                     break
             memo[id(e)] = cand

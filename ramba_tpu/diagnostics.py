@@ -1,0 +1,94 @@
+"""Public observability surface: one import to see the whole system.
+
+    import ramba_tpu
+    ramba_tpu.diagnostics.report()            # human-readable summary
+    ramba_tpu.diagnostics.counters()          # {"fuser.cache_miss": 3, ...}
+    ramba_tpu.diagnostics.last_flushes(5)     # newest-last flush spans
+    ramba_tpu.diagnostics.dump("state.json")  # machine-readable snapshot
+
+The reference exposes get_timing()/print_comm_stats piecemeal
+(ramba.py:3840-3848,4120-4142); this module is the rebuild's single pane:
+counters registry + timers + the event ring (flush spans, health records)
+in one place.  For offline trace files (RAMBA_TRACE), use
+``scripts/trace_report.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Optional
+
+from ramba_tpu.observe import events as _events, registry as _registry
+
+
+def counters() -> dict:
+    """Copy of every named counter (see observe/registry.py for the
+    naming convention)."""
+    return dict(_registry.counters)
+
+
+def last_flushes(n: int = 10) -> list:
+    """The newest ``n`` flush spans from the in-memory ring (newest last).
+    Each span carries label, instr count, cache hit/miss, compile vs
+    execute seconds, byte totals, and per-compiled-call children."""
+    return _events.last(n, type="flush")
+
+
+def health_events(n: int = 10) -> list:
+    return _events.last(n, type="health")
+
+
+def snapshot() -> dict:
+    """Everything, JSON-serializable: registry stores + the event ring."""
+    snap = _registry.snapshot()
+    snap["events"] = list(_events.ring)
+    return snap
+
+
+def report(file=None) -> None:
+    """Human-readable one-shot summary to ``file`` (default stderr)."""
+    from ramba_tpu.utils import timing as _timing
+
+    file = file or sys.stderr
+    print("=== ramba_tpu diagnostics ===", file=file)
+    cs = counters()
+    if cs:
+        print("-- counters --", file=file)
+        for k in sorted(cs):
+            print(f"  {k:<40s} {cs[k]:>14,d}", file=file)
+    hs = health_events()
+    if hs:
+        print("-- health --", file=file)
+        for ev in hs:
+            bits = [f"{k}={ev[k]}" for k in
+                    ("platform", "device_count", "outcome", "init_seconds",
+                     "selected_via", "error") if k in ev]
+            print("  " + " ".join(bits), file=file)
+    fl = last_flushes()
+    if fl:
+        print(f"-- last {len(fl)} flush span(s) --", file=file)
+        for ev in fl:
+            print(
+                f"  {ev.get('label', '?'):<18s} instrs={ev.get('instrs', 0):<5d}"
+                f" cache={ev.get('cache', '?'):<4s}"
+                f" wall={ev.get('wall_s', 0.0):.4f}s"
+                f" compile={ev.get('compile_s', 0.0):.4f}s"
+                f" execute={ev.get('execute_s', 0.0):.4f}s",
+                file=file,
+            )
+    _timing.timing_summary(file=file)
+    _timing.print_comm_stats(file=file)
+
+
+def dump(path: str) -> str:
+    """Write ``snapshot()`` as JSON to ``path``; returns the path."""
+    with open(path, "w") as f:
+        json.dump(snapshot(), f, default=str)
+    return path
+
+
+def reset() -> None:
+    """Clear counters, timers, and the event ring (tests/benchmarks)."""
+    _registry.reset()
+    _events.ring.clear()

@@ -451,21 +451,40 @@ register_loader(["rtd"], _load_rtd)
 
 
 def _driver_write_barrier(write_fn) -> None:
-    """Single-writer multi-controller file write: rank 0 writes, then a
-    cross-process barrier so no rank reads an incomplete file.  Every
-    process must call this (SPMD lockstep) — the barrier is collective."""
+    """Single-writer multi-controller file write: rank 0 writes, then every
+    process learns whether the write SUCCEEDED — a failed driver write
+    raises on all ranks, not just rank 0.  Every process must call this
+    (SPMD lockstep) — the flag broadcast is itself the collective barrier,
+    so no rank proceeds to read an incomplete file."""
     import jax
 
     if jax.process_count() > 1:
-        try:
-            if jax.process_index() == 0:
-                write_fn()
-        finally:
-            # the barrier must run even when the write fails, or every
-            # other rank blocks in it forever (they can't see the error)
-            from jax.experimental import multihost_utils
+        from jax.experimental import multihost_utils
 
-            multihost_utils.sync_global_devices("ramba_tpu_file_write")
+        err = None
+        if jax.process_index() == 0:
+            try:
+                write_fn()
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                err = e
+        # collective: blocks until rank 0 contributes its flag (the
+        # broadcast doubles as the completion barrier the old
+        # sync_global_devices provided)
+        failed = int(
+            multihost_utils.broadcast_one_to_all(
+                np.int32(0 if err is None else 1)
+            )
+        )
+        from ramba_tpu.parallel import distributed as _distributed
+
+        _distributed.note_transfer("broadcast", np.int32().nbytes)
+        if err is not None:
+            raise err
+        if failed:
+            raise RuntimeError(
+                "driver (process 0) failed to write the file; see its log "
+                "for the original exception"
+            )
     else:
         write_fn()
 

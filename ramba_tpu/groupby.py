@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ramba_tpu.core.expr import Node, defop
+from ramba_tpu.utils import compat as _compat
 from ramba_tpu.core.ndarray import ndarray, as_exprable
 from ramba_tpu.ops.creation import asarray
 
@@ -69,7 +70,7 @@ def _dist_segment_multi(pairs, labels, num_groups, mesh):
             for (op, _), b in zip(pairs, blocks)
         )
 
-    partials = jax.shard_map(
+    partials = _compat.shard_map(
         local, mesh=mesh,
         in_specs=(_P(axes),) * (1 + len(ds)),
         out_specs=(_P(axes),) * len(ds),

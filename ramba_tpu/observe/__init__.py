@@ -1,0 +1,23 @@
+"""First-class observability for the flush pipeline.
+
+The reference ships opt-in wall-clock timers and DAG debug dumps
+(/root/reference/ramba/ramba.py:923-1019,4481-4509); this package is the
+rebuild's production posture on top of those seeds: every flush emits a
+structured span (``events``), every subsystem increments named counters in
+one registry (``registry``), hardware bring-up lands health records in the
+same stream (``health``), and ``RAMBA_PROFILE_DIR`` lines the whole thing
+up with jax.profiler/Perfetto traces (``profile``).
+
+Environment variables:
+
+* ``RAMBA_TRACE=<path>`` — append one JSON object per event to ``<path>``
+  (``<path>.rank<i>`` per process under multi-controller SPMD).
+* ``RAMBA_TRACE_RING=<n>`` — in-memory ring size (default 256; the ring is
+  always on, file output only when RAMBA_TRACE is set).
+* ``RAMBA_PROFILE_DIR=<dir>`` — capture a jax.profiler trace of every
+  flush, annotated by program label.
+
+Public read API lives in ``ramba_tpu.diagnostics``.
+"""
+
+from ramba_tpu.observe import events, health, profile, registry  # noqa: F401

@@ -1,0 +1,111 @@
+"""Structured event/span stream: in-memory ring, optional JSONL file.
+
+Every flush (core/fuser.py) and hardware bring-up (observe/health.py) emits
+one event dict here.  The ring buffer is ALWAYS on — it is a bounded deque
+append, cheap enough for the hot path — while file output engages only when
+``RAMBA_TRACE=<path>`` is set.  Under multi-controller SPMD each process
+writes its own ``<path>.rank<i>`` file (same single-writer discipline as
+fileio's driver-gated saves, without serializing ranks through one fd).
+
+The file is line-buffered JSON-lines: one object per line, so a crashed run
+still yields a parseable prefix (scripts/trace_report.py consumes partial
+files).  Events carry ``ts`` (unix seconds), ``seq`` (per-process monotone),
+and ``rank`` (multi-controller only).
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import json
+import os
+import time
+from typing import Optional
+
+_RING_MAX = max(1, int(os.environ.get("RAMBA_TRACE_RING", "256") or 256))
+
+# newest-last bounded history; ramba_tpu.diagnostics reads it
+ring: "collections.deque" = collections.deque(maxlen=_RING_MAX)
+
+_trace_path: Optional[str] = os.environ.get("RAMBA_TRACE") or None
+_trace_file = None
+_seq = 0
+_rank: Optional[int] = None
+
+
+def trace_enabled() -> bool:
+    return _trace_path is not None
+
+
+def configure(path: Optional[str]) -> None:
+    """(Re)point the JSONL sink — primarily for tests; production use is
+    the RAMBA_TRACE environment variable read at import."""
+    global _trace_path
+    close()
+    _trace_path = path or None
+
+
+def _rank_info():
+    """(rank, nprocs) — requires an initialized jax backend, so it is read
+    lazily at first emit (always after bring-up) and cached."""
+    global _rank
+    if _rank is None:
+        try:
+            import jax
+
+            _rank = (jax.process_index(), jax.process_count())
+        except Exception:  # backend unavailable: single-process semantics
+            _rank = (0, 1)
+    return _rank
+
+
+def _file():
+    global _trace_file
+    if _trace_file is None and _trace_path is not None:
+        rank, nprocs = _rank_info()
+        path = _trace_path if nprocs <= 1 else f"{_trace_path}.rank{rank}"
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        _trace_file = open(path, "a", buffering=1)  # line-buffered
+    return _trace_file
+
+
+def emit(event: dict) -> dict:
+    """Stamp and record one event.  Mutates ``event`` in place (adds
+    ts/seq/rank) and returns it.  Never raises out of the sink: a full
+    disk must not take the computation down with it."""
+    global _seq
+    _seq += 1
+    event.setdefault("ts", round(time.time(), 6))
+    event["seq"] = _seq
+    rank, nprocs = _rank_info() if _trace_path is not None else (None, 1)
+    if nprocs > 1:
+        event["rank"] = rank
+    ring.append(event)
+    if _trace_path is not None:
+        try:
+            _file().write(json.dumps(event, default=str) + "\n")
+        except OSError:
+            pass
+    return event
+
+
+def last(n: int = 10, type: Optional[str] = None) -> list:
+    """Newest-last slice of the ring, optionally filtered by event type."""
+    evs = list(ring)
+    if type is not None:
+        evs = [e for e in evs if e.get("type") == type]
+    return evs[-n:] if n else evs
+
+
+def close() -> None:
+    global _trace_file
+    if _trace_file is not None:
+        try:
+            _trace_file.close()
+        except OSError:
+            pass
+        _trace_file = None
+
+
+atexit.register(close)

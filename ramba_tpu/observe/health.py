@@ -1,0 +1,69 @@
+"""TPU-health event source: platform selection, init probes, fallbacks.
+
+Two rounds of benchmarking were lost to an opaque ``tpu_init_error`` string
+(BENCH_r05.json): the chip wedged, the run fell back to CPU, and nothing
+recorded when/why.  This module turns bring-up into first-class events in
+the same stream as flush spans:
+
+* ``record()`` — explicit health record (bench.py calls it with its
+  subprocess-probe outcome and timings),
+* ``record_mesh()`` — automatic record on the FIRST default-mesh creation
+  (parallel/mesh.py), so every traced run carries at least one health line
+  stating which platform actually executed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ramba_tpu.observe import events, registry
+
+_mesh_recorded = False
+
+
+def record(
+    platform: Optional[str] = None,
+    device_count: Optional[int] = None,
+    init_seconds: Optional[float] = None,
+    outcome: str = "ok",
+    error: Optional[str] = None,
+    selected_via: Optional[str] = None,
+    **extra,
+) -> dict:
+    """Emit one health event.  ``outcome``: "ok" | "fallback" | "error".
+    Returns the emitted event dict (bench.py folds it into its JSON line).
+    """
+    ev = {"type": "health", "outcome": outcome}
+    if platform is not None:
+        ev["platform"] = platform
+    if device_count is not None:
+        ev["device_count"] = int(device_count)
+    if init_seconds is not None:
+        ev["init_seconds"] = round(float(init_seconds), 4)
+    if error:
+        ev["error"] = str(error)[-800:]
+    if selected_via is not None:
+        ev["selected_via"] = selected_via
+    ev.update(extra)
+    registry.inc(f"health.{outcome}")
+    return events.emit(ev)
+
+
+def record_mesh(mesh, init_seconds: float) -> None:
+    """Health record for the first default mesh (one per process)."""
+    global _mesh_recorded
+    if _mesh_recorded:
+        return
+    _mesh_recorded = True
+    try:
+        dev = mesh.devices.flat[0]
+        record(
+            platform=getattr(dev, "platform", None),
+            device_count=int(mesh.devices.size),
+            init_seconds=init_seconds,
+            outcome="ok",
+            source="default_mesh",
+            mesh_shape={k: int(v) for k, v in mesh.shape.items()},
+        )
+    except Exception:  # observability must never break bring-up
+        pass

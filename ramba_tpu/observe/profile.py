@@ -1,0 +1,61 @@
+"""jax.profiler integration: RAMBA_PROFILE_DIR lines flushes up with xprof.
+
+With ``RAMBA_PROFILE_DIR=<dir>`` set, the first flush starts a
+``jax.profiler.trace`` into that directory (stopped atexit) and every flush
+dispatch runs inside a ``TraceAnnotation`` named by the fused program's
+label — so the Perfetto/TensorBoard timeline shows which ramba program each
+XLA module execution belongs to.  This supersedes the ad-hoc
+``RAMBA_TIMING>=2`` annotation previously buried in core/fuser.py (which
+still works: annotations engage when EITHER gate is on).
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import os
+
+_DIR = os.environ.get("RAMBA_PROFILE_DIR") or None
+_started = False
+
+
+def enabled() -> bool:
+    return _DIR is not None
+
+
+def ensure_started() -> None:
+    """Start the profiler trace once (no-op unless RAMBA_PROFILE_DIR)."""
+    global _started
+    if _DIR is None or _started:
+        return
+    _started = True
+    import jax.profiler as _prof
+
+    os.makedirs(_DIR, exist_ok=True)
+    _prof.start_trace(_DIR)
+    atexit.register(_stop)
+
+
+def _stop() -> None:
+    global _started
+    if not _started:
+        return
+    _started = False
+    try:
+        import jax.profiler as _prof
+
+        _prof.stop_trace()
+    except Exception:  # interpreter teardown: best-effort
+        pass
+
+
+def annotation(label: str):
+    """TraceAnnotation context when profiling (or RAMBA_TIMING>=2) is on;
+    a free nullcontext otherwise — safe on the per-flush hot path."""
+    from ramba_tpu import common
+
+    if _DIR is None and common.timing_level <= 1:
+        return contextlib.nullcontext()
+    import jax.profiler as _prof
+
+    return _prof.TraceAnnotation(label)

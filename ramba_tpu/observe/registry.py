@@ -1,0 +1,78 @@
+"""Single process-wide metrics store: counters + timers + comm gauges.
+
+The reference scatters its instrumentation over private module dicts
+(``time_dict``/``sub_time_dict``/``per_func`` in ramba.py:923-1019, per-queue
+byte stats in ramba_queue_zmq.py:127-135).  Here every store lives in ONE
+module so ``ramba_tpu.diagnostics`` can snapshot the whole system at once;
+``utils/timing.py`` keeps its public surface by aliasing these same objects
+(the dicts below ARE ``timing.time_dict`` etc. — one store, two names).
+
+Counter naming convention: ``<subsystem>.<event>`` — e.g.
+``fuser.cache_miss``, ``rewrite.rewrite_arange_reshape``,
+``skeletons.host_fallback``, ``stencil.halo_bytes_est``,
+``distributed.allgather_bytes``.  ``*_bytes``/``*_bytes_est`` counters
+accumulate byte totals; everything else counts occurrences.  ``*_est``
+byte counters for collectives are computed from static shapes at jax trace
+time, so they count bytes per *compiled structure*, not per execution —
+XLA's profiler owns exact per-execution collective traffic.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+# occurrence / byte counters: name -> int
+counters: dict = defaultdict(int)
+
+# name -> [total_seconds, call_count]  (aliased as timing.time_dict)
+timers: dict = defaultdict(lambda: [0.0, 0])
+# (parent, name) -> [total_seconds, call_count]  (timing.sub_time_dict)
+sub_timers: dict = defaultdict(lambda: [0.0, 0])
+# program label -> [total_seconds, call_count]  (timing.per_func)
+per_func: dict = defaultdict(lambda: [0.0, 0])
+
+# host<->device boundary traffic (timing.comm_stats)
+comm: dict = {
+    "host_to_device_bytes": 0, "host_to_device_count": 0,
+    "device_to_host_bytes": 0, "device_to_host_count": 0,
+}
+
+
+def inc(name: str, n: int = 1) -> None:
+    """Increment a named counter (hot-path safe: one dict add)."""
+    counters[name] += n
+
+
+def get(name: str) -> int:
+    return counters.get(name, 0)
+
+
+def snapshot() -> dict:
+    """Point-in-time copy of every store (JSON-serializable except
+    sub_timers' tuple keys, which stringify as 'parent/name')."""
+    return {
+        "counters": dict(counters),
+        "timers": {k: tuple(v) for k, v in timers.items()},
+        "sub_timers": {f"{p}/{s}": tuple(v)
+                       for (p, s), v in sub_timers.items()},
+        "per_func": {k: tuple(v) for k, v in per_func.items()},
+        "comm": dict(comm),
+    }
+
+
+def reset_counters() -> None:
+    counters.clear()
+
+
+def reset_timers() -> None:
+    """Clear the timer stores (the historical ``timing.reset`` scope)."""
+    timers.clear()
+    sub_timers.clear()
+    per_func.clear()
+    for k in comm:
+        comm[k] = 0
+
+
+def reset() -> None:
+    reset_counters()
+    reset_timers()

@@ -35,7 +35,9 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ramba_tpu import common
+from ramba_tpu.observe import registry as _registry
 from ramba_tpu.parallel import mesh as _mesh
+from ramba_tpu.utils import compat as _compat
 
 # Interior/halo overlap in the sharded path (off: single full-block eval)
 _OVERLAP = __import__("os").environ.get(
@@ -94,6 +96,11 @@ def _exchange(x, axis, axes_names, nshards, lo_amt, hi_amt):
         )
         if nshards > 1:
             perm = [(i, i + 1) for i in range(nshards - 1)]
+            # trace-time estimate: every non-end shard ships one halo slab
+            _registry.inc(
+                "stencil.halo_bytes_est",
+                len(perm) * math.prod(send.shape) * send.dtype.itemsize,
+            )
             parts.append(jax.lax.ppermute(send, axes_names, perm))
         else:
             parts.append(jnp.zeros_like(send))
@@ -102,6 +109,10 @@ def _exchange(x, axis, axes_names, nshards, lo_amt, hi_amt):
         send = jax.lax.slice_in_dim(x, 0, hi_amt, axis=axis)
         if nshards > 1:
             perm = [(i, i - 1) for i in range(1, nshards)]
+            _registry.inc(
+                "stencil.halo_bytes_est",
+                len(perm) * math.prod(send.shape) * send.dtype.itemsize,
+            )
             parts.append(jax.lax.ppermute(send, axes_names, perm))
         else:
             parts.append(jnp.zeros_like(send))
@@ -178,7 +189,7 @@ def run(func, lo, hi, slots, arrs, taps):
     spec = P(*(
         (e[0] if len(e) == 1 else tuple(e)) if e else None for e in ents
     ))
-    out = jax.shard_map(
+    out = _compat.shard_map(
         local, mesh=mesh, in_specs=spec, out_specs=spec, check_vma=False
     )(*arrs)
     if padded_shape != shape:

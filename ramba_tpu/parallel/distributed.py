@@ -68,13 +68,44 @@ def initialize(
             return
     except ImportError:
         pass
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        **kwargs,
-    )
+    import time
+
+    t0 = time.perf_counter()
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kwargs,
+        )
+    except Exception as e:
+        from ramba_tpu.observe import health as _health
+
+        _health.record(
+            outcome="error", error=repr(e), source="distributed_init",
+            init_seconds=time.perf_counter() - t0,
+        )
+        raise
     _initialized = True
+    from ramba_tpu.observe import health as _health
+
+    _health.record(
+        outcome="ok", source="distributed_init",
+        init_seconds=time.perf_counter() - t0,
+        process_count=jax.process_count(),
+        process_index=jax.process_index(),
+    )
+
+
+def note_transfer(kind: str, nbytes: int) -> None:
+    """Account one cross-process transfer in the observability registry
+    (kind: "allgather" | "broadcast" | ...).  Call sites: ndarray.asarray's
+    process_allgather, fileio's driver-write flag broadcast."""
+    from ramba_tpu.observe import registry as _registry
+
+    _registry.inc(f"distributed.{kind}_count")
+    if nbytes:
+        _registry.inc(f"distributed.{kind}_bytes", int(nbytes))
 
 
 def shutdown() -> None:

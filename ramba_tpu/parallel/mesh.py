@@ -36,7 +36,11 @@ mesh_epoch: int = 0
 
 
 def _make_default_mesh() -> Mesh:
-    devices = jax.devices()
+    import time
+
+    t0 = time.perf_counter()
+    devices = jax.devices()  # first call triggers backend init (TPU probe)
+    init_s = time.perf_counter() - t0
     n = len(devices)
     if common.num_workers_env is not None:
         n = min(n, int(common.num_workers_env))
@@ -46,7 +50,11 @@ def _make_default_mesh() -> Mesh:
     factors = tuple(f for f in factors if f > 1) or (1,)
     names = tuple(f"d{i}" for i in range(len(factors)))
     dev_array = np.array(devices).reshape(factors)
-    return Mesh(dev_array, axis_names=names)
+    mesh = Mesh(dev_array, axis_names=names)
+    from ramba_tpu.observe import health as _health
+
+    _health.record_mesh(mesh, init_s)
+    return mesh
 
 
 def get_mesh() -> Mesh:

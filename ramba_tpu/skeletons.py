@@ -41,8 +41,10 @@ from ramba_tpu import common
 from ramba_tpu.core.expr import Const, Node, defop
 from ramba_tpu.core.fuser import sync as _sync
 from ramba_tpu.core.ndarray import ndarray
+from ramba_tpu.observe import registry as _registry
 from ramba_tpu.ops.creation import asarray
 from ramba_tpu.parallel import mesh as _mesh
+from ramba_tpu.utils import compat as _compat
 
 # ---------------------------------------------------------------------------
 # smap / smap_index
@@ -316,7 +318,15 @@ def _call_kernel(func, *vals):
     if not branched:  # pragma: no cover - defensive
         raise KernelTraceError(_BRANCH_MSG)
     wrapped = _kwrap(vals)
-    leaves = _explore_branches(lambda: func(*wrapped))
+    try:
+        leaves = _explore_branches(lambda: func(*wrapped))
+    except (TypeError, jax.errors.TracerBoolConversionError) as e:
+        # the branch-exploring re-trace hit something untraceable that the
+        # first probe did not (e.g. a host conversion only reachable down a
+        # forced branch path): surface it as a KernelTraceError so smap's
+        # host fallback engages instead of an opaque jax error
+        raise KernelTraceError(_BRANCH_MSG) from e
+    _registry.inc("skeletons.branch_lowered")
     return _combine_branches(leaves)
 
 
@@ -483,6 +493,7 @@ def _op_smap(static, *arrs):
             return vec(*iotas, *arrs)
         return vec(*arrs)
     except KernelTraceError:
+        _registry.inc("skeletons.host_fallback")
         return _host_smap(func, slots, with_index, ndim, arrs)
 
 
@@ -571,7 +582,7 @@ def _op_sreduce(static, mapped):
                          lambda a, b: _call_kernel(local_fn, a, b))
         return r[None]
 
-    partials = jax.shard_map(
+    partials = _compat.shard_map(
         local, mesh=mesh, in_specs=P(axes), out_specs=P(axes),
         check_vma=False,
     )(flat)
@@ -790,7 +801,14 @@ def call_stencil_body(func, build_args):
         except KernelBranchError:
             pass
     wrapped = build_args(True)
-    leaves = _explore_branches(lambda: func(*wrapped))
+    try:
+        leaves = _explore_branches(lambda: func(*wrapped))
+    except (TypeError, jax.errors.TracerBoolConversionError) as e:
+        # see _call_kernel: untraceable constructs first reached during the
+        # branch re-trace become a KernelTraceError with the actionable
+        # message instead of a raw tracer error
+        raise KernelTraceError(_BRANCH_MSG) from e
+    _registry.inc("skeletons.branch_lowered")
     return _combine_branches(leaves)
 
 
@@ -964,7 +982,7 @@ def _probe_associative(local_func, final_func) -> bool:
 
 @defop("scumulative")
 def _op_scumulative(static, x):
-    local_func, final_func, associative, axis = static
+    local_func, final_func, associative, axis, distribute = static
     x = jnp.moveaxis(x, axis, 0)  # scan along the leading axis
     n = x.shape[0]
     rest = x.shape[1:]
@@ -989,7 +1007,7 @@ def _op_scumulative(static, x):
         )
         return ys
 
-    if nsh == 1 or n < max(nsh * 2, common.dist_threshold):
+    if not distribute or nsh == 1 or n < nsh * 2:
         return jnp.moveaxis(local_scan(x), 0, axis)
 
     # Distributed: per-shard scan under shard_map, then a cross-shard carry
@@ -1001,6 +1019,13 @@ def _op_scumulative(static, x):
     pad = (-n) % nsh
     xp = (
         jnp.pad(x, [(0, pad)] + [(0, 0)] * len(rest)) if pad else x
+    )
+    # trace-time estimate of the carry fix-up collective: every shard
+    # all-gathers the per-shard totals, nsh rest-slices each
+    _registry.inc(
+        "skeletons.scan_allgather_bytes_est",
+        nsh * nsh * int(np.prod(rest, dtype=np.int64))
+        * np.dtype(x.dtype).itemsize,
     )
 
     def per_shard(b):
@@ -1022,7 +1047,7 @@ def _op_scumulative(static, x):
         return jnp.where(idx == 0, ys, fixed)
 
     spec = P(axes, *([None] * len(rest)))
-    out = jax.shard_map(
+    out = _compat.shard_map(
         per_shard, mesh=mesh, in_specs=spec, out_specs=spec,
         check_vma=False,
     )(xp)
@@ -1034,24 +1059,56 @@ def _op_scumulative(static, x):
 _warned_nonassoc = False
 
 
-def _warn_nonassoc_sharded(arr, axis) -> None:
+def _scan_axis_shards(arr, axis, mesh) -> int:
+    """How many mesh shards actually split ``axis`` of ``arr``: read the
+    operand's concrete sharding spec when it is a realized leaf on the
+    current mesh, otherwise the spec the planner would assign
+    (``default_spec``).  Replaces the old global-mesh-size heuristic — an
+    array replicated (or sharded only on OTHER axes) scans each block whole
+    regardless of how many devices the mesh has."""
+    spec = None
+    try:
+        e = arr._expr
+        if isinstance(e, Const):
+            sh = getattr(e.value, "sharding", None)
+            smesh = getattr(sh, "mesh", None)
+            if (
+                smesh is not None
+                and tuple(getattr(smesh, "axis_names", ()))
+                == tuple(mesh.axis_names)
+                and getattr(sh, "spec", None) is not None
+            ):
+                spec = tuple(sh.spec)
+    except Exception:
+        spec = None
+    if spec is None:
+        spec = tuple(_mesh.default_spec(arr.shape, mesh))
+    entry = spec[axis] if axis < len(spec) else None
+    if entry is None:
+        return 1
+    names = entry if isinstance(entry, (tuple, list)) else (entry,)
+    k = 1
+    for nm in names:
+        k *= int(mesh.shape.get(nm, 1))
+    return k
+
+
+def _warn_nonassoc_sharded(k, nsh) -> None:
     """Round-4 verdict #8: a non-rebasable kernel on a sharded scan axis is
     exact only per block (per-block carry semantics, same as the
-    reference's scumulative_final) — say so loudly, once."""
+    reference's scumulative_final) — say so loudly, once.  ``k`` is the
+    shard count along the scan axis (from ``_scan_axis_shards``); the
+    caller only invokes this when the distributed path will actually run."""
     global _warned_nonassoc
     if _warned_nonassoc:
         return
     import warnings
 
-    mesh = _mesh.get_mesh()
-    nsh = int(np.prod(list(mesh.shape.values())))
-    n = arr.shape[axis] if arr.ndim else 0
-    if nsh <= 1 or n < max(nsh * 2, common.dist_threshold):
-        return  # single-shard path: exact sequential semantics
     _warned_nonassoc = True
     warnings.warn(
         "scumulative: the kernel failed the associativity probe and the "
-        f"scan axis is sharded over {nsh} devices.  Each shard scans its own "
+        f"scan axis is sharded over {k} of the mesh's {nsh} devices.  "
+        "Each shard scans its own "
         "block and the cross-shard carry is applied via final_func(boundary, "
         "block) — per-block carry semantics, identical to the reference's "
         "scumulative_final, which can differ from an exact sequential scan "
@@ -1093,12 +1150,22 @@ def scumulative(local_func, final_func, arr, axis=0, dtype=None, out=None,
         arr = arr.astype(dtype)
     if associative is None:
         associative = _probe_associative(local_func, final_func)
-    if not associative:
-        _warn_nonassoc_sharded(arr, axis)
+    mesh = _mesh.get_mesh()
+    nsh = int(np.prod(list(mesh.shape.values())))
+    n = arr.shape[axis] if arr.ndim else 0
+    k = _scan_axis_shards(arr, axis, mesh) if nsh > 1 else 1
+    # distribute only when the scan axis is actually split: a replicated
+    # operand (or one sharded on other axes) scans whole blocks locally,
+    # exactly — no carry fix-up, no warning
+    distribute = (
+        nsh > 1 and k > 1 and n >= max(nsh * 2, common.dist_threshold)
+    )
+    if not associative and distribute:
+        _warn_nonassoc_sharded(k, nsh)
     res = ndarray(
         Node(
             "scumulative",
-            (local_func, final_func, bool(associative), axis),
+            (local_func, final_func, bool(associative), axis, distribute),
             [arr.read_expr()],
         )
     )
@@ -1416,7 +1483,7 @@ def spmd(func, *args):
             outs.append(o)
         return tuple(outs)
 
-    outs = jax.shard_map(
+    outs = _compat.shard_map(
         inner, mesh=mesh, in_specs=tuple(specs), out_specs=tuple(specs),
         check_vma=False,
     )(*vals)

@@ -1,0 +1,40 @@
+"""Shims over jax API drift.
+
+The codebase targets current jax spellings; this module maps them onto
+whatever the installed jax provides so the repo runs on older releases
+without scattering version checks through the kernels:
+
+* ``shard_map`` — top-level ``jax.shard_map`` (with ``check_vma``) vs the
+  older ``jax.experimental.shard_map.shard_map`` (with ``check_rep``).
+* ``typeof`` — ``jax.typeof`` vs ``jax.core.get_aval`` (same ShapedArray
+  for concrete arrays); core/expr.py keeps its own copy to avoid an import
+  cycle at package init.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma,
+        )
+
+
+typeof = getattr(jax, "typeof", None)
+if typeof is None:
+
+    def typeof(value):
+        return jax.core.get_aval(value)

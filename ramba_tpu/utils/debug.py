@@ -128,13 +128,16 @@ def drain_effect_errors() -> Exception | None:
 
 def _dump_history() -> None:
     """Write flush statistics at exit (reference: dag-count history files,
-    ramba.py:5120-5128)."""
+    ramba.py:5120-5128) plus the full observability counter registry."""
     from ramba_tpu.core import fuser
+    from ramba_tpu.observe import registry
 
     try:
         with open("ramba_tpu_flush_history.txt", "w") as f:
             for k, v in fuser.stats.items():
                 f.write(f"{k}: {v}\n")
+            for k in sorted(registry.counters):
+                f.write(f"{k}: {registry.counters[k]}\n")
     except OSError:
         pass
 

@@ -15,6 +15,11 @@ TPU-native rebuild of the reference's opt-in timing subsystem:
 There are no worker processes to aggregate from (the reference gathers
 worker timers over RPC in ``get_timing``, ramba.py:3840-3848): one controller
 process drives the TPU mesh, so all timers live here.
+
+The stores themselves now live in ``ramba_tpu.observe.registry`` — this
+module aliases the SAME dict objects, so the historical public surface
+(``time_dict``/``sub_time_dict``/``per_func``/``comm_stats``) keeps working
+while ``ramba_tpu.diagnostics`` snapshots one registry.
 """
 
 from __future__ import annotations
@@ -22,18 +27,18 @@ from __future__ import annotations
 import atexit
 import sys
 import time
-from collections import defaultdict
 from contextlib import contextmanager
 from typing import Optional
 
 from ramba_tpu import common
+from ramba_tpu.observe import registry as _registry
 
 # name -> [total_seconds, call_count]
-time_dict: dict = defaultdict(lambda: [0.0, 0])
+time_dict: dict = _registry.timers
 # (parent, name) -> [total_seconds, call_count]
-sub_time_dict: dict = defaultdict(lambda: [0.0, 0])
+sub_time_dict: dict = _registry.sub_timers
 # program label -> [total_seconds, call_count]  (reference: per_func)
-per_func: dict = defaultdict(lambda: [0.0, 0])
+per_func: dict = _registry.per_func
 
 
 def add_time(name: str, seconds: float) -> None:
@@ -84,10 +89,7 @@ def timer(name: str, parent: Optional[str] = None):
 # On TPU the queues are gone; the host boundary transfers are what remain
 # observable — inter-device traffic is XLA collectives over ICI, visible
 # only to the profiler).
-comm_stats: dict = {
-    "host_to_device_bytes": 0, "host_to_device_count": 0,
-    "device_to_host_bytes": 0, "device_to_host_count": 0,
-}
+comm_stats: dict = _registry.comm
 
 
 def note_transfer(direction: str, nbytes: int) -> None:
@@ -114,11 +116,9 @@ def print_comm_stats(file=None) -> None:
 
 
 def reset() -> None:
-    time_dict.clear()
-    sub_time_dict.clear()
-    per_func.clear()
-    for k in comm_stats:
-        comm_stats[k] = 0
+    # clears the registry's timer stores (same objects as the aliases here);
+    # named counters are reset separately via observe.registry/diagnostics
+    _registry.reset_timers()
 
 
 def get_timing() -> dict:

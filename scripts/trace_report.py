@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Summarize a RAMBA_TRACE JSONL file.
+
+Usage:
+    python scripts/trace_report.py /tmp/t.jsonl [more.jsonl ...]
+
+Accepts the path passed to RAMBA_TRACE directly; when the run was
+multi-controller the per-rank files (``<path>.rank0``, ``<path>.rank1``, ...)
+are discovered automatically.  Stdlib only — runs anywhere the trace file
+can be copied to, no jax required.
+
+Prints, per input:
+  * health records (platform, device count, init time, fallback reasons),
+  * flush totals: count, wall time, compile vs execute split, cache hit
+    rate, instructions, bytes in (leaves) and out (roots),
+  * rewrite-rule fire totals, and
+  * the top programs by cumulative wall time.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import sys
+from collections import defaultdict
+
+
+def _discover(path: str) -> list:
+    """The file itself, or its .rank* siblings (multi-controller runs)."""
+    files = []
+    import os
+
+    if os.path.exists(path):
+        files.append(path)
+    files += sorted(glob.glob(glob.escape(path) + ".rank*"))
+    return files
+
+
+def _load(path: str) -> list:
+    events = []
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                print(f"{path}:{ln}: unparseable line ({e})", file=sys.stderr)
+    return events
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024 or unit == "TB":
+            return f"{n:,.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:,.1f} TB"
+
+
+def report(path: str, events: list, top: int = 10, file=None) -> None:
+    file = file or sys.stdout
+    print(f"== {path} ({len(events)} events) ==", file=file)
+
+    health = [e for e in events if e.get("type") == "health"]
+    for h in health:
+        bits = [f"{k}={h[k]}" for k in
+                ("platform", "device_count", "outcome", "init_seconds",
+                 "selected_via", "source") if k in h]
+        print("health: " + " ".join(bits), file=file)
+        if h.get("error"):
+            print(f"  error: {h['error']}", file=file)
+
+    flushes = [e for e in events if e.get("type") == "flush"]
+    if not flushes:
+        print("no flush spans", file=file)
+        return
+
+    wall = sum(f.get("wall_s", 0.0) for f in flushes)
+    compile_s = sum(f.get("compile_s", 0.0) for f in flushes)
+    execute_s = sum(f.get("execute_s", 0.0) for f in flushes)
+    linearize_s = sum(f.get("linearize_s", 0.0) for f in flushes)
+    hits = sum(1 for f in flushes if f.get("cache") == "hit")
+    instrs = sum(f.get("instrs", 0) for f in flushes)
+    leaf_b = sum(f.get("leaf_bytes", 0) for f in flushes)
+    out_b = sum(f.get("out_bytes", 0) for f in flushes)
+    donated = sum(f.get("donated", 0) for f in flushes)
+    segs = sum(f.get("segments", 0) for f in flushes)
+
+    print(
+        f"flushes: {len(flushes)}  wall {wall:.4f}s  "
+        f"(linearize {linearize_s:.4f}s, compile {compile_s:.4f}s, "
+        f"execute-cached {execute_s:.4f}s)",
+        file=file,
+    )
+    print(
+        f"cache: {hits}/{len(flushes)} hit "
+        f"({100.0 * hits / len(flushes):.0f}%)  "
+        f"instrs: {instrs}  segments: {segs}  donated bufs: {donated}",
+        file=file,
+    )
+    print(
+        f"bytes: in {_fmt_bytes(leaf_b)}  out {_fmt_bytes(out_b)}",
+        file=file,
+    )
+
+    fires = defaultdict(int)
+    for f in flushes:
+        for rule, n in (f.get("rewrite_fires") or {}).items():
+            fires[rule] += n
+    if fires:
+        print("rewrite fires: " + "  ".join(
+            f"{r}={n}" for r, n in sorted(fires.items())), file=file)
+
+    per = defaultdict(lambda: [0.0, 0, 0.0])  # label -> [wall, count, compile]
+    for f in flushes:
+        ent = per[f.get("label", "?")]
+        ent[0] += f.get("wall_s", 0.0)
+        ent[1] += 1
+        ent[2] += f.get("compile_s", 0.0)
+    print(f"top {min(top, len(per))} programs by wall time:", file=file)
+    ranked = sorted(per.items(), key=lambda kv: -kv[1][0])[:top]
+    for label, (w, cnt, comp) in ranked:
+        print(
+            f"  {label:<18s} {w:10.4f}s  x{cnt:<5d} compile {comp:.4f}s",
+            file=file,
+        )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Summarize RAMBA_TRACE JSONL trace files."
+    )
+    ap.add_argument("paths", nargs="+",
+                    help="trace file(s); .rank* siblings auto-discovered")
+    ap.add_argument("--top", type=int, default=10,
+                    help="programs to list (default 10)")
+    args = ap.parse_args(argv)
+
+    files = []
+    for p in args.paths:
+        found = _discover(p)
+        if not found:
+            print(f"{p}: no trace file found", file=sys.stderr)
+            return 2
+        files += [f for f in found if f not in files]
+
+    for f in files:
+        report(f, _load(f), top=args.top)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,6 +43,11 @@ def main() -> int:
     basetemp = tempfile.mkdtemp(prefix="ramba_2proc_")
     budget = float(os.environ.get("RAMBA_TEST_PROCS_TIMEOUT", "2400"))
 
+    # Trace leg: both ranks stream flush spans; multi-controller emit
+    # writes per-rank files <path>.rank0 / <path>.rank1 (observe/events.py)
+    # which are asserted parseable below.
+    trace_base = os.path.join(basetemp, "trace.jsonl")
+
     procs = []
     logs = []
     for rank in range(2):
@@ -54,6 +59,7 @@ def main() -> int:
         env["RAMBA_TEST_PROC_ID"] = str(rank)
         env["RAMBA_TEST_COORD"] = f"localhost:{port}"
         env["RAMBA_TEST_SHARED_TMP"] = os.path.join(basetemp, "shared")
+        env["RAMBA_TRACE"] = trace_base
         log = open(os.path.join(basetemp, f"rank{rank}.log"), "w")
         logs.append(log)
         procs.append(subprocess.Popen(
@@ -77,6 +83,28 @@ def main() -> int:
             log.close()
 
     ok = all(rc == 0 for rc in rcs)
+
+    # Both ranks must have produced a parseable JSONL trace with at least
+    # one flush span — the observability stream works under SPMD.
+    import json
+
+    for rank in range(2):
+        path = f"{trace_base}.rank{rank}"
+        try:
+            with open(path) as f:
+                evs = [json.loads(ln) for ln in f if ln.strip()]
+            n_flush = sum(1 for e in evs if e.get("type") == "flush")
+            bad_rank = sum(1 for e in evs if e.get("rank") != rank)
+            print(f"trace rank {rank}: {len(evs)} events, "
+                  f"{n_flush} flush spans")
+            if n_flush == 0 or bad_rank:
+                print(f"trace rank {rank}: FAIL "
+                      f"(flush={n_flush}, mis-ranked={bad_rank})")
+                ok = False
+        except (OSError, ValueError) as e:
+            print(f"trace rank {rank}: FAIL ({e})")
+            ok = False
+
     for rank in range(2):
         path = os.path.join(basetemp, f"rank{rank}.log")
         with open(path) as f:

@@ -38,6 +38,14 @@ if PROCS <= 1:
         os.environ.get("XLA_FLAGS", "")
         + f" --xla_force_host_platform_device_count={N_DEVICES}"
     )
+else:
+    # per-rank local device count via XLA_FLAGS — works on every jax
+    # version (the jax_num_cpu_devices config option is newer than some
+    # supported releases)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={max(1, N_DEVICES // PROCS)}"
+    )
 
 X64 = os.environ.get("RAMBA_TEST_X64", "1") not in ("0", "")
 
@@ -50,7 +58,6 @@ if os.environ.get("RAMBA_TEST_TPU", "") in ("1", "true"):
     jax.config.update("jax_enable_x64", False)
 elif PROCS > 1:
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", max(1, N_DEVICES // PROCS))
     jax.config.update("jax_enable_x64", X64)
 
     from ramba_tpu.parallel import distributed

@@ -1,0 +1,194 @@
+"""Observability: flush spans, the counter registry, and the trace sink.
+
+Covers the ``ramba_tpu.observe`` package + ``ramba_tpu.diagnostics``:
+
+* every flush emits a span into the in-memory ring with compile/execute
+  attribution and a cache flag (miss on first compile, hit on re-run),
+* named counters fire for rewrite-rule applications and smap host
+  fallbacks,
+* ``RAMBA_TRACE=<path>`` produces a valid JSONL file with exactly one
+  record per flush (checked in a subprocess so the env var is read at
+  import, as in production), and ``scripts/trace_report.py`` summarizes it,
+* with tracing disabled the ring still records spans but no file is
+  touched.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import jax as _jax
+import ramba_tpu as rt
+from ramba_tpu import common, diagnostics
+from ramba_tpu.core import fuser
+from ramba_tpu.observe import events
+
+_MULTIPROC = _jax.process_count() > 1
+
+_SPAN_KEYS = (
+    "label", "instrs", "n_leaves", "linearize_s", "rewrite_fires",
+    "donated", "leaf_bytes", "out_bytes", "segments", "cache",
+    "compile_s", "execute_s", "wall_s", "calls",
+)
+
+
+def _run_chain():
+    a = rt.arange(512) * 3.0 + 1.0
+    return float(rt.sum(a))
+
+
+def test_flush_span_miss_then_hit():
+    fuser.flush()  # drain unrelated pending work
+    fuser._compile_cache.clear()
+    before = diagnostics.counters()
+
+    v1 = _run_chain()
+    span1 = diagnostics.last_flushes(1)[0]
+    for k in _SPAN_KEYS:
+        assert k in span1, f"flush span missing {k!r}"
+    assert span1["type"] == "flush"
+    assert span1["cache"] == "miss"
+    assert span1["compile_s"] > 0.0
+    assert span1["instrs"] >= 1
+    assert span1["wall_s"] >= span1["compile_s"]
+    assert span1["calls"] and span1["calls"][0]["cache"] == "miss"
+
+    v2 = _run_chain()
+    span2 = diagnostics.last_flushes(1)[0]
+    assert span2 is not span1
+    assert span2["label"] == span1["label"]
+    assert span2["cache"] == "hit"
+    assert span2["compile_s"] == 0.0
+    assert span2["execute_s"] > 0.0
+    assert v1 == v2
+
+    after = diagnostics.counters()
+    assert after.get("fuser.cache_miss", 0) >= before.get("fuser.cache_miss", 0) + 1
+    assert after.get("fuser.cache_hit", 0) >= before.get("fuser.cache_hit", 0) + 1
+    assert after.get("fuser.flushes", 0) >= before.get("fuser.flushes", 0) + 2
+
+
+@pytest.mark.skipif(
+    not common.rewrite_enabled, reason="graph rewrites disabled by env"
+)
+def test_rewrite_fire_counter_and_span():
+    fuser.flush()
+    before = diagnostics.counters().get("rewrite.rewrite_arange_reshape", 0)
+    r = rt.arange(4096).reshape(64, 64)
+    np.asarray(r)
+    after = diagnostics.counters().get("rewrite.rewrite_arange_reshape", 0)
+    assert after >= before + 1
+    span = diagnostics.last_flushes(1)[0]
+    assert span["rewrite_fires"].get("rewrite_arange_reshape", 0) >= 1
+
+
+@pytest.mark.skipif(
+    _MULTIPROC,
+    reason="pure_callback host fallback is single-controller only",
+)
+def test_host_fallback_counter():
+    def countdown(x):
+        n = x
+        while n > 0:
+            n = n - 1.0
+        return n
+
+    before = diagnostics.counters().get("skeletons.host_fallback", 0)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out = np.asarray(rt.smap(countdown, [2.5, -1.0, 0.5]))
+    np.testing.assert_allclose(out, [-0.5, -1.0, -0.5])
+    after = diagnostics.counters().get("skeletons.host_fallback", 0)
+    assert after >= before + 1
+
+
+def test_branch_lowered_counter():
+    before = diagnostics.counters().get("skeletons.branch_lowered", 0)
+    out = np.asarray(rt.smap(lambda x: x + 1 if x > 0 else x - 1, [1.0, -1.0]))
+    np.testing.assert_allclose(out, [2.0, -2.0])
+    after = diagnostics.counters().get("skeletons.branch_lowered", 0)
+    assert after >= before + 1
+
+
+def test_diagnostics_report_and_dump(tmp_path, capsys):
+    _run_chain()
+    import io
+
+    buf = io.StringIO()
+    diagnostics.report(file=buf)
+    text = buf.getvalue()
+    assert "ramba_tpu diagnostics" in text
+    assert "counters" in text
+    rank = os.environ.get("RAMBA_TEST_PROC_ID", "0")
+    p = diagnostics.dump(str(tmp_path / f"diag_{rank}.json"))
+    with open(p) as f:
+        snap = json.load(f)
+    assert "counters" in snap and "events" in snap
+
+
+def test_trace_jsonl_one_record_per_flush(tmp_path):
+    rank = os.environ.get("RAMBA_TEST_PROC_ID", "0")
+    path = tmp_path / f"trace_{rank}.jsonl"
+    code = (
+        "import numpy as np\n"
+        "import ramba_tpu as rt\n"
+        "a = rt.arange(256) * 2.0\n"
+        "float(rt.sum(a))\n"
+        "b = rt.arange(256) * 2.0\n"
+        "float(rt.sum(b))\n"
+        "np.asarray(rt.arange(1024).reshape(32, 32))\n"
+        "from ramba_tpu.core import fuser\n"
+        "print('FLUSHES=%d' % fuser.stats['flushes'])\n"
+    )
+    env = dict(os.environ)
+    for k in ("RAMBA_TEST_PROCS", "RAMBA_TEST_PROC_ID", "RAMBA_TEST_COORD",
+              "RAMBA_TEST_SHARED_TMP", "RAMBA_PROFILE_DIR"):
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RAMBA_TRACE"] = str(path)
+    r = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    n_flushes = int(r.stdout.strip().rsplit("FLUSHES=", 1)[1])
+
+    lines = [ln for ln in path.read_text().splitlines() if ln.strip()]
+    evs = [json.loads(ln) for ln in lines]  # every line must parse
+    flushes = [e for e in evs if e.get("type") == "flush"]
+    assert len(flushes) == n_flushes
+    for f in flushes:
+        for k in _SPAN_KEYS:
+            assert k in f, f"trace record missing {k!r}"
+        assert f["cache"] in ("hit", "miss")
+    # identical chains: first compiles, second hits the cache
+    assert flushes[0]["cache"] == "miss"
+    assert any(f["cache"] == "hit" for f in flushes)
+
+    rep = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "scripts",
+                      "trace_report.py"),
+         str(path)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert rep.returncode == 0, rep.stderr[-2000:]
+    assert "flushes:" in rep.stdout
+    assert "cache:" in rep.stdout
+
+
+@pytest.mark.skipif(
+    bool(os.environ.get("RAMBA_TRACE")),
+    reason="this process has tracing enabled (two-process trace leg)",
+)
+def test_disabled_trace_writes_no_file():
+    assert not events.trace_enabled()
+    n0 = len(events.ring)
+    _run_chain()
+    assert len(events.ring) > n0 or events.ring.maxlen == len(events.ring)
+    assert events._trace_file is None  # no sink ever opened
