@@ -99,6 +99,7 @@ from ramba_tpu.utils.remote import get, jit, remote  # noqa: F401
 from ramba_tpu.utils import debug  # noqa: F401
 from ramba_tpu import diagnostics  # noqa: F401
 from ramba_tpu import observe  # noqa: F401
+from ramba_tpu import resilience  # noqa: F401
 from ramba_tpu.utils import timing  # noqa: F401
 from ramba_tpu.utils.timing import (  # noqa: F401
     add_sub_time, add_time, annotate, get_timing, get_timing_str,

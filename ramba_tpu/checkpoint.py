@@ -6,6 +6,21 @@ Orbax writes each array's shards from their owning devices (OCDBT format)
 and restores them directly into a target sharding, so neither direction
 stages the full array on the host.
 
+Resilience contract:
+
+* ``save`` is **atomic**: Orbax writes into a temp sibling
+  (``<path>.ramba-tmp``) which is renamed over the final path only once
+  the write completed — the published path always holds either the old
+  complete checkpoint or the new one, never a torn write.  Under
+  multi-controller SPMD all ranks barrier around a rank-0 rename.
+* Transient I/O failures retry under ``resilience.retry`` (site
+  ``checkpoint_io``); the ``RAMBA_FAULTS=checkpoint_io:...`` injection
+  site drives both paths in tests.
+* ``restore`` validates what came back (tree structure and per-leaf
+  shape/dtype against the target) and wraps unreadable/corrupt
+  checkpoints in :class:`CheckpointCorruptError` with the original error
+  chained, instead of an opaque Orbax stack.
+
 API:
 
     ramba_tpu.checkpoint.save(path, {"w": W, "b": B})
@@ -16,6 +31,7 @@ API:
 from __future__ import annotations
 
 import os
+import shutil
 
 import jax
 import numpy as np
@@ -23,6 +39,26 @@ import numpy as np
 from ramba_tpu.core.expr import Const
 from ramba_tpu.core.fuser import flush
 from ramba_tpu.core.ndarray import ndarray
+from ramba_tpu.observe import registry as _registry
+from ramba_tpu.resilience import faults as _faults
+from ramba_tpu.resilience import retry as _retry
+
+
+class CheckpointCorruptError(RuntimeError):
+    """The on-disk checkpoint is missing, unreadable, structurally wrong,
+    or does not match the requested restore target."""
+
+
+# Deterministic tmp sibling (not mkdtemp): every SPMD rank must compute
+# the same staging path, and a crashed writer's debris is findable.
+_TMP_SUFFIX = ".ramba-tmp"
+
+
+def _barrier(tag: str) -> None:
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
 
 
 def save(path: str, tree, *, force: bool = False) -> None:
@@ -30,16 +66,40 @@ def save(path: str, tree, *, force: bool = False) -> None:
 
     ``force=False`` (Orbax's own safe default) errors if ``path`` already
     holds a checkpoint instead of deleting it; pass ``force=True`` to
-    overwrite deliberately."""
+    overwrite deliberately.  The write is staged + renamed, so with
+    ``force=True`` a crash mid-save leaves the previous checkpoint
+    intact."""
     import orbax.checkpoint as ocp
 
+    apath = os.path.abspath(path)
+    if os.path.exists(apath) and not force:
+        raise ValueError(
+            f"refusing to overwrite existing checkpoint at {path!r}; "
+            f"pass force=True"
+        )
     flush()
     vals = jax.tree.map(
         lambda x: x._value() if isinstance(x, ndarray) else np.asarray(x),
         tree,
     )
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(os.path.abspath(path), vals, force=force)
+    tmp = apath + _TMP_SUFFIX
+
+    def write():
+        _faults.check("checkpoint_io", op="save")
+        if jax.process_index() == 0 and os.path.exists(tmp):
+            shutil.rmtree(tmp)  # debris from a crashed/failed earlier save
+        _barrier("ramba_ckpt_clear")
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(tmp, vals, force=True)
+
+    _retry.call("checkpoint_io", write)
+    _barrier("ramba_ckpt_written")
+    if jax.process_index() == 0:
+        if os.path.exists(apath):
+            shutil.rmtree(apath)
+        os.replace(tmp, apath)
+    _barrier("ramba_ckpt_published")
+    _registry.inc("checkpoint.saves")
 
 
 def restore(path: str, target=None):
@@ -52,6 +112,10 @@ def restore(path: str, target=None):
     different mesh."""
     import orbax.checkpoint as ocp
 
+    apath = os.path.abspath(path)
+    if not os.path.isdir(apath):
+        raise CheckpointCorruptError(f"no checkpoint directory at {path!r}")
+
     def spec(x):
         if isinstance(x, ndarray):
             v = x._value()
@@ -63,11 +127,85 @@ def restore(path: str, target=None):
             f"ShapeDtypeStructs, got {type(x).__name__}"
         )
 
-    with ocp.StandardCheckpointer() as ckptr:
-        if target is not None:
-            out = ckptr.restore(
-                os.path.abspath(path), jax.tree.map(spec, target)
-            )
-        else:
-            out = ckptr.restore(os.path.abspath(path))
+    tgt = jax.tree.map(spec, target) if target is not None else None
+
+    # Orbax restore is not strict about global shape (a mismatched target
+    # silently truncates/pads), so a target is vetted against the
+    # checkpoint's own metadata BEFORE any bytes are restored.
+    if tgt is not None:
+        try:
+            with ocp.StandardCheckpointer() as ckptr:
+                meta = ckptr.metadata(apath)
+        except Exception as e:
+            raise CheckpointCorruptError(
+                f"checkpoint at {path!r} has unreadable metadata "
+                f"({type(e).__name__}: {e})"
+            ) from e
+        _validate_target(path, meta, tgt)
+
+    def read():
+        _faults.check("checkpoint_io", op="restore")
+        with ocp.StandardCheckpointer() as ckptr:
+            if tgt is not None:
+                return ckptr.restore(apath, tgt)
+            return ckptr.restore(apath)
+
+    try:
+        out = _retry.call("checkpoint_io", read)
+    except (_retry.RetryBudgetExhausted, _faults.InjectedFault):
+        raise
+    except Exception as e:
+        raise CheckpointCorruptError(
+            f"checkpoint at {path!r} is unreadable or does not match the "
+            f"restore target ({type(e).__name__}: {e})"
+        ) from e
+    _validate(path, out, tgt)
+    _registry.inc("checkpoint.restores")
     return jax.tree.map(lambda v: ndarray(Const(v)), out)
+
+
+def _validate_target(path: str, meta, tgt) -> None:
+    """A restore target must match what the checkpoint actually holds —
+    tree structure and per-leaf shape/dtype — before restore runs."""
+    got_s, want_s = jax.tree.structure(meta), jax.tree.structure(tgt)
+    if got_s != want_s:
+        raise CheckpointCorruptError(
+            f"checkpoint at {path!r} tree structure {got_s} does not match "
+            f"restore target {want_s}"
+        )
+    for saved, want in zip(jax.tree.leaves(meta), jax.tree.leaves(tgt)):
+        if tuple(saved.shape) != tuple(want.shape) or (
+            np.dtype(saved.dtype) != np.dtype(want.dtype)
+        ):
+            raise CheckpointCorruptError(
+                f"checkpoint at {path!r} holds leaf "
+                f"{tuple(saved.shape)}/{np.dtype(saved.dtype)} but the "
+                f"restore target wants {tuple(want.shape)}/{want.dtype}"
+            )
+
+
+def _validate(path: str, out, tgt) -> None:
+    """Post-restore validation: every leaf must be an array, and with a
+    target the tree structure and per-leaf shape/dtype must match it."""
+    for v in jax.tree.leaves(out):
+        if not (hasattr(v, "shape") and hasattr(v, "dtype")):
+            raise CheckpointCorruptError(
+                f"checkpoint at {path!r} restored a non-array leaf "
+                f"({type(v).__name__})"
+            )
+    if tgt is None:
+        return
+    got_s, want_s = jax.tree.structure(out), jax.tree.structure(tgt)
+    if got_s != want_s:
+        raise CheckpointCorruptError(
+            f"checkpoint at {path!r} tree structure {got_s} does not match "
+            f"restore target {want_s}"
+        )
+    for got, want in zip(jax.tree.leaves(out), jax.tree.leaves(tgt)):
+        if tuple(got.shape) != tuple(want.shape) or (
+            np.dtype(got.dtype) != np.dtype(want.dtype)
+        ):
+            raise CheckpointCorruptError(
+                f"checkpoint at {path!r} leaf {got.shape}/{got.dtype} does "
+                f"not match restore target {want.shape}/{want.dtype}"
+            )

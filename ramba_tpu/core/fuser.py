@@ -41,6 +41,8 @@ from ramba_tpu.observe import events as _events
 from ramba_tpu.observe import profile as _profile
 from ramba_tpu.observe import registry as _registry
 from ramba_tpu.parallel import mesh as _mesh
+from ramba_tpu.resilience import degrade as _degrade
+from ramba_tpu.resilience import faults as _faults
 from ramba_tpu.utils import timing as _timing
 
 # Donation is pointless for small buffers and fragments the jit cache (the
@@ -230,7 +232,17 @@ def _prepare_program(exprs: Sequence[Expr]):
     if common.rewrite_enabled:
         from ramba_tpu.core.rewrite import rewrite_roots
 
-        exprs = rewrite_roots(exprs)
+        try:
+            exprs = rewrite_roots(exprs)
+        except Exception as e:
+            # The rewriter is an optimizer: a crash in it must never take
+            # the flush down.  Degrade to the unrewritten graph.
+            _registry.inc("resilience.rewrite_bypassed")
+            _events.emit({
+                "type": "degrade", "site": "rewrite", "action": "rung",
+                "from": "rewritten", "to": "unrewritten",
+                "error": f"{type(e).__name__}: {e}"[:300],
+            })
     return _linearize(exprs)
 
 
@@ -255,6 +267,7 @@ def _get_compiled(program: _Program, donate_key: tuple):
         return fn, False
     if len(_compile_cache) >= _COMPILE_CACHE_MAX:
         _compile_cache.pop(next(iter(_compile_cache)))
+    _faults.check("compile", instrs=len(program.instrs))
     fn = jax.jit(_build_callable(program), donate_argnums=donate_key)
     _compile_cache[key] = fn
     stats["compiles"] += 1
@@ -276,15 +289,17 @@ def _last_use_map(program: _Program) -> dict:
     return last_use
 
 
-def _iter_segments(program: _Program, last_use: dict):
-    """Split ``program`` into sub-programs of at most
-    ``common.max_program_instrs`` instructions.  Yields
+def _iter_segments(program: _Program, last_use: dict,
+                   seg_size: Optional[int] = None):
+    """Split ``program`` into sub-programs of at most ``seg_size``
+    (default ``common.max_program_instrs``) instructions.  Yields
     ``(seg_prog, in_slots, out_here, top)`` where ``in_slots`` are the
     parent-program value slots the segment consumes, ``out_here`` the
     parent slots it must emit (used later or program outputs), and ``top``
     the first parent slot index past this segment."""
     instrs, n_leaves = program.instrs, program.n_leaves
-    seg_size = common.max_program_instrs
+    if seg_size is None:
+        seg_size = common.max_program_instrs
     ninstr = len(instrs)
     start = 0
     while start < ninstr:
@@ -314,9 +329,10 @@ def _iter_segments(program: _Program, last_use: dict):
 
 
 def _run_segmented(program: _Program, leaf_vals: list, donate_idx: tuple,
-                   span: Optional[dict] = None):
+                   span: Optional[dict] = None,
+                   seg_size: Optional[int] = None):
     """Execute an oversized program as chained jit calls of at most
-    ``common.max_program_instrs`` instructions each.
+    ``seg_size`` (default ``common.max_program_instrs``) instructions each.
 
     XLA compile time grows superlinearly with program length (a 3000-op
     elementwise chain took minutes on CPU), so one giant jit is a
@@ -331,7 +347,9 @@ def _run_segmented(program: _Program, leaf_vals: list, donate_idx: tuple,
     last_use = _last_use_map(program)
     donate_set = set(donate_idx)
     vals: dict[int, object] = dict(enumerate(leaf_vals))
-    for seg_prog, in_slots, out_here, top in _iter_segments(program, last_use):
+    for seg_prog, in_slots, out_here, top in _iter_segments(
+        program, last_use, seg_size
+    ):
         seg_donate = []
         for j, s in enumerate(in_slots):
             if last_use.get(s, 0) >= top:
@@ -363,6 +381,8 @@ def _execute_compiled(fn, program: _Program, leaf_vals, is_new: bool,
     when ``span`` is given — a per-call child record in the flush span.
     Used by both the monolithic and segmented flush paths so the two can
     never drift."""
+    _faults.check("execute", instrs=len(program.instrs))
+    _faults.check("oom", instrs=len(program.instrs))
     if is_new and common.show_code:
         import sys
 
@@ -403,6 +423,114 @@ def _execute_compiled(fn, program: _Program, leaf_vals, is_new: bool,
             "seconds": round(dt, 6),
         })
     return outs
+
+
+def _attempt_fused(program: _Program, leaf_vals, donate_key: tuple,
+                   span: Optional[dict]):
+    """Rung 0: the normal fused path (monolithic jit, or the standard
+    segmented executor above ``common.max_program_instrs``)."""
+    if (
+        common.max_program_instrs
+        and len(program.instrs) > common.max_program_instrs
+    ):
+        return _run_segmented(program, leaf_vals, donate_key, span=span)
+    fn, is_new = _get_compiled(program, donate_key)
+    return _execute_compiled(fn, program, leaf_vals, is_new, span=span)
+
+
+def _run_eager(program: _Program, leaf_vals, span: Optional[dict]):
+    """Rung 2: per-op eager dispatch — no jit, no fusion, no donation.
+    Blocks on the results so any execution failure surfaces inside this
+    rung (eager dispatch is async) rather than at a later materialize."""
+    _faults.check("eager")
+    t0 = time.perf_counter()
+    # allow_all: eager ops on non-fully-addressable (multi-host) arrays
+    # are refused by default; this rung runs them op-by-op deliberately
+    with jax.spmd_mode("allow_all"):
+        outs = _build_callable(program)(*leaf_vals)
+    outs = jax.block_until_ready(outs)
+    if span is not None:
+        span["calls"].append({
+            "label": _program_label(program),
+            "cache": "eager",
+            "seconds": round(time.perf_counter() - t0, 6),
+        })
+    return outs
+
+
+def _run_host(program: _Program, leaf_vals, span: Optional[dict]):
+    """Rung 3 (last): interpret the whole program on the CPU backend —
+    device → host fallback as a first-class path.  Inputs are pulled to
+    host memory, the program runs eagerly on CPU, and outputs are placed
+    back onto the accelerator mesh when it will accept them (kept
+    host-committed otherwise: a degraded-but-correct result beats a
+    crash).  Only offered single-controller — under multi-host SPMD no
+    single process holds the global array."""
+    _faults.check("host")
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    t0 = time.perf_counter()
+    cpu = jax.devices("cpu")[0]
+    host_vals = []
+    for v in leaf_vals:
+        if isinstance(v, jax.Array):
+            v = jax.device_put(np.asarray(v), cpu)
+        host_vals.append(v)
+    with jax.default_device(cpu):
+        outs = _build_callable(program)(*host_vals)
+    outs = jax.block_until_ready(outs)
+    mesh = _mesh.get_mesh()
+    res = []
+    for o in outs:
+        try:
+            spec = _mesh.default_spec(o.shape, mesh)
+            res.append(jax.device_put(o, NamedSharding(mesh, spec)))
+        except Exception:
+            res.append(o)
+    if span is not None:
+        span["calls"].append({
+            "label": _program_label(program),
+            "cache": "host",
+            "seconds": round(time.perf_counter() - t0, 6),
+        })
+    return tuple(res)
+
+
+def _execute_resilient(program: _Program, leaf_vals, donate_key: tuple,
+                       span: Optional[dict]):
+    """Run the program down the degradation ladder (see
+    ``resilience.degrade``): fused → split → eager → host.  Returns
+    ``(outs, rung_name)``; rung_name is "fused" on the healthy path."""
+    rungs = [
+        ("fused",
+         lambda: _attempt_fused(program, leaf_vals, donate_key, span)),
+    ]
+    if len(program.instrs) > 1:
+        cap = common.max_program_instrs or len(program.instrs)
+        half = max(1, min(len(program.instrs), cap) // 2)
+        # no leaf donation below the fused rung: a donated buffer consumed
+        # by a failed attempt could not feed the next rung
+        rungs.append(
+            ("split",
+             lambda: _run_segmented(program, leaf_vals, (), span=span,
+                                    seg_size=half)))
+    rungs.append(("eager", lambda: _run_eager(program, leaf_vals, span)))
+    try:
+        single = jax.process_count() == 1
+    except Exception:
+        single = True
+    if single:
+        rungs.append(("host", lambda: _run_host(program, leaf_vals, span)))
+
+    def leaves_alive() -> bool:
+        for v in leaf_vals:
+            is_deleted = getattr(v, "is_deleted", None)
+            if is_deleted is not None and is_deleted():
+                return False
+        return True
+
+    return _degrade.run_ladder("flush", rungs, leaf_check=leaves_alive)
 
 
 def flush(extra: Sequence[Expr] = ()) -> list:
@@ -464,19 +592,32 @@ def flush(extra: Sequence[Expr] = ()) -> list:
     span["donated"] = len(donate)
     span["leaf_bytes"] = leaf_bytes
     _profile.ensure_started()
-    with _profile.annotation("ramba_flush:" + label):
-        with warnings.catch_warnings():
-            warnings.filterwarnings("ignore", message=".*[Dd]onat.*")
-            if (
-                common.max_program_instrs
-                and len(program.instrs) > common.max_program_instrs
-            ):
-                outs = _run_segmented(program, leaf_vals, donate_key,
-                                      span=span)
-            else:
-                fn, is_new = _get_compiled(program, donate_key)
-                outs = _execute_compiled(fn, program, leaf_vals, is_new,
-                                         span=span)
+    try:
+        with _profile.annotation("ramba_flush:" + label):
+            with warnings.catch_warnings():
+                warnings.filterwarnings("ignore", message=".*[Dd]onat.*")
+                outs, rung = _execute_resilient(program, leaf_vals,
+                                                donate_key, span)
+    except Exception as e:
+        # Quarantine: every rung of the ladder failed (or the error was
+        # fatal).  The roots of THIS program must leave the pending
+        # registry, or the one broken expression re-enters — and re-fails —
+        # every subsequent flush in the process, cascading one error into
+        # unbounded collateral failures.  The arrays keep their lazy
+        # graphs; a later materialization re-attempts each one alone
+        # (ndarray._value), so innocent co-pending arrays still produce
+        # their values and only the truly broken graph re-raises.
+        for arr in roots:
+            unregister_pending(arr)
+        _registry.inc("resilience.flush_quarantined", len(roots))
+        _events.emit({
+            "type": "flush_error", "label": label,
+            "quarantined": len(roots),
+            "error": f"{type(e).__name__}: {e}"[:300],
+        })
+        raise
+    if rung != "fused":
+        span["degraded"] = rung
     stats["flushes"] += 1
     stats["nodes_flushed"] += len(program.instrs)
     _registry.inc("fuser.flushes")

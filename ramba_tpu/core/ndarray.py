@@ -309,6 +309,14 @@ class ndarray:
         if self._base is None:
             if not isinstance(self._expr, Const):
                 fuser.flush()
+            if not isinstance(self._expr, Const):
+                # Still lazy after a flush: an earlier failed flush
+                # quarantined this array (fuser.flush pulls the roots of a
+                # program that exhausted the degradation ladder out of the
+                # pending registry).  Re-attempt this graph alone — an
+                # innocent co-pending array materializes fine; a genuinely
+                # broken one re-raises its real error here.
+                self._set_expr(Const(fuser.flush(extra=[self._expr])[0]))
             return self._expr.value
         return fuser.flush(extra=[self.read_expr()])[0]
 

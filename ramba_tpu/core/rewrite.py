@@ -27,6 +27,7 @@ import numpy as np
 
 from ramba_tpu.core.expr import Const, Expr, Node
 from ramba_tpu.observe import registry as _registry
+from ramba_tpu.resilience import faults as _faults
 
 REDUCE_KINDS = {"mean", "nanmean", "sum", "nansum", "min", "max", "prod"}
 
@@ -335,6 +336,7 @@ def rewrite_roots(roots):
     """Apply RULES bottom-up across the expression forest (iterative — chains
     can be deeper than the Python recursion limit, cf. the fuser's iterative
     linearizer)."""
+    _faults.check("rewrite")
     memo: dict[int, Expr] = {}
     out = []
     for root in roots:
@@ -361,6 +363,14 @@ def rewrite_roots(roots):
                 try:
                     r = rule(cand)
                 except Exception:
+                    # Matching is meant to be defensive (a mismatch returns
+                    # None); a rule that *raises* has a bug, and silently
+                    # eating it hides the bug forever — count it so the
+                    # miss shows up in diagnostics.
+                    _registry.inc("resilience.rewrite_rule_error")
+                    _registry.inc(
+                        f"resilience.rewrite_rule_error.{rule.__name__}"
+                    )
                     r = None
                 if r is not None:
                     stats[rule.__name__] += 1

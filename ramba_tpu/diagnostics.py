@@ -39,6 +39,13 @@ def health_events(n: int = 10) -> list:
     return _events.last(n, type="health")
 
 
+def resilience_events(n: int = 20) -> list:
+    """Newest-last fault/degradation events — the same degradation
+    timeline ``RAMBA_TRACE`` records (fault injections, per-site retries,
+    ladder rung transitions, recoveries)."""
+    return _events.last(n, type=("fault", "degrade"))
+
+
 def snapshot() -> dict:
     """Everything, JSON-serializable: registry stores + the event ring."""
     snap = _registry.snapshot()
@@ -65,6 +72,14 @@ def report(file=None) -> None:
                     ("platform", "device_count", "outcome", "init_seconds",
                      "selected_via", "error") if k in ev]
             print("  " + " ".join(bits), file=file)
+    rs = resilience_events()
+    if rs:
+        print(f"-- resilience timeline (last {len(rs)}) --", file=file)
+        for ev in rs:
+            bits = [f"{k}={ev[k]}" for k in
+                    ("site", "action", "attempt", "from", "to", "rung",
+                     "mode", "error") if ev.get(k) is not None]
+            print(f"  {ev.get('type', '?'):<8s}" + " ".join(bits), file=file)
     fl = last_flushes()
     if fl:
         print(f"-- last {len(fl)} flush span(s) --", file=file)

@@ -23,8 +23,23 @@ import numpy as np
 
 from ramba_tpu.core.ndarray import ndarray
 from ramba_tpu.ops.creation import fromarray
+from ramba_tpu.resilience import faults as _faults
+from ramba_tpu.resilience import retry as _retry
 
 _LOADERS: dict = {}
+
+
+def _resilient_io(op: str, fn):
+    """Run one read/write thunk under the ``fileio`` retry policy (site for
+    both the backoff budget and ``RAMBA_FAULTS=fileio:...`` injection).
+    Transient I/O errors back off and re-run ``fn``; unrecoverable ones
+    (missing file, permissions) propagate immediately."""
+
+    def thunk():
+        _faults.check("fileio", op=op)
+        return fn()
+
+    return _retry.call("fileio", thunk)
 
 # Chunked-read observability (used by tests to prove host memory stays
 # bounded to shard size — the reference achieves the same by having each
@@ -67,11 +82,14 @@ def _sharded_from_reader(shape, dtype, read_slice) -> ndarray:
     if not builtins_any(e is not None for e in entries):
         # replicated (small or indivisible) array: one read, one put
         io_stats["whole_array_reads"] += 1
-        return fromarray(read_slice(tuple(slice(0, d) for d in shape)))
+        whole = tuple(slice(0, d) for d in shape)
+        return fromarray(_resilient_io("read", lambda: read_slice(whole)))
     sh = NamedSharding(mesh, spec)
 
     def cb(index):
-        buf = np.ascontiguousarray(read_slice(index))
+        buf = np.ascontiguousarray(
+            _resilient_io("read", lambda: read_slice(index))
+        )
         io_stats["chunks"] += 1
         io_stats["max_chunk_bytes"] = max(io_stats["max_chunk_bytes"],
                                           buf.nbytes)
@@ -254,7 +272,9 @@ def _save_rtd(path: str, arr) -> None:
     os.makedirs(path, exist_ok=True)
     pid = jax.process_index()
     try:
-        _write_rtd_part(path, v, pid)
+        # _write_rtd_part clears this rank's stale files first, so a
+        # retried attempt restarts from a clean slate
+        _resilient_io("write", lambda: _write_rtd_part(path, v, pid))
     finally:
         if jax.process_count() > 1:
             # every process must see every part before anyone may load —
@@ -464,7 +484,7 @@ def _driver_write_barrier(write_fn) -> None:
         err = None
         if jax.process_index() == 0:
             try:
-                write_fn()
+                _resilient_io("write", write_fn)
             except BaseException as e:  # noqa: BLE001 - re-raised below
                 err = e
         # collective: blocks until rank 0 contributes its flag (the
@@ -486,7 +506,7 @@ def _driver_write_barrier(write_fn) -> None:
                 "for the original exception"
             )
     else:
-        write_fn()
+        _resilient_io("write", write_fn)
 
 
 def save(path: str, arr) -> None:
@@ -529,29 +549,37 @@ def save(path: str, arr) -> None:
         return
     shape, dtype = _arr_meta(arr)
     if ext == "npy":
-        # open_memmap writes the .npy header then exposes the data region;
-        # shard writes land directly in the page cache
-        out = np.lib.format.open_memmap(
-            path, mode="w+", dtype=dtype, shape=shape
-        )
-        try:
-            for idx, chunk in _shard_chunks(arr):
-                out[idx] = chunk
-            out.flush()
-        finally:
-            del out
+        def write_npy():
+            # open_memmap writes the .npy header then exposes the data
+            # region; shard writes land directly in the page cache.  A
+            # retried attempt recreates the file from scratch.
+            out = np.lib.format.open_memmap(
+                path, mode="w+", dtype=dtype, shape=shape
+            )
+            try:
+                for idx, chunk in _shard_chunks(arr):
+                    out[idx] = chunk
+                out.flush()
+            finally:
+                del out
+
+        _resilient_io("write", write_npy)
     else:  # h5/hdf5 — extensions were validated upfront
         try:
             import h5py  # type: ignore
         except ImportError as e:
             raise ImportError("h5py is required for HDF5 saving") from e
-        with h5py.File(path, "w") as f:
-            dset = f.create_dataset("data", shape=shape, dtype=dtype)
-            for idx, chunk in _shard_chunks(arr):
-                if shape == ():
-                    dset[()] = chunk
-                else:
-                    dset[idx] = chunk
+
+        def write_h5():
+            with h5py.File(path, "w") as f:
+                dset = f.create_dataset("data", shape=shape, dtype=dtype)
+                for idx, chunk in _shard_chunks(arr):
+                    if shape == ():
+                        dset[()] = chunk
+                    else:
+                        dset[idx] = chunk
+
+        _resilient_io("write", write_h5)
 
 
 def loadtxt(fname, dtype=float, comments="#", delimiter=None, skiprows=0,
@@ -559,15 +587,19 @@ def loadtxt(fname, dtype=float, comments="#", delimiter=None, skiprows=0,
     """numpy.loadtxt → distributed array (host parse, sharded on arrival)."""
     from ramba_tpu.ops.creation import fromarray
 
-    return fromarray(np.loadtxt(fname, dtype=dtype, comments=comments,
-                                delimiter=delimiter, skiprows=skiprows,
-                                usecols=usecols, ndmin=ndmin))
+    return fromarray(_resilient_io(
+        "read",
+        lambda: np.loadtxt(fname, dtype=dtype, comments=comments,
+                           delimiter=delimiter, skiprows=skiprows,
+                           usecols=usecols, ndmin=ndmin),
+    ))
 
 
 def genfromtxt(fname, **kwargs):
     from ramba_tpu.ops.creation import fromarray
 
-    return fromarray(np.genfromtxt(fname, **kwargs))
+    return fromarray(_resilient_io("read",
+                                   lambda: np.genfromtxt(fname, **kwargs)))
 
 
 def savetxt(fname, X, fmt="%.18e", delimiter=" ", newline="\n", header="",

@@ -90,11 +90,13 @@ def emit(event: dict) -> dict:
     return event
 
 
-def last(n: int = 10, type: Optional[str] = None) -> list:
-    """Newest-last slice of the ring, optionally filtered by event type."""
+def last(n: int = 10, type=None) -> list:
+    """Newest-last slice of the ring, optionally filtered by event type
+    (a single type string or a tuple/list of them)."""
     evs = list(ring)
     if type is not None:
-        evs = [e for e in evs if e.get("type") == type]
+        types = (type,) if isinstance(type, str) else tuple(type)
+        evs = [e for e in evs if e.get("type") in types]
     return evs[-n:] if n else evs
 
 

@@ -49,6 +49,14 @@ def record(
     return events.emit(ev)
 
 
+def record_recovery(source: str, retries: int, **extra) -> dict:
+    """A transient failure healed after ``retries`` re-attempt(s) — the
+    resilience retry engine reports recoveries here so incidents that
+    did NOT become hard failures still show up in the health stream."""
+    return record(outcome="recovered", source=source,
+                  retries=int(retries), **extra)
+
+
 def record_mesh(mesh, init_seconds: float) -> None:
     """Health record for the first default mesh (one per process)."""
     global _mesh_recorded

@@ -47,6 +47,12 @@ def get(name: str) -> int:
     return counters.get(name, 0)
 
 
+def prefixed(prefix: str) -> dict:
+    """Counters under one subsystem prefix (e.g. ``prefixed("resilience.")``
+    → every fault/retry/degradation counter)."""
+    return {k: v for k, v in counters.items() if k.startswith(prefix)}
+
+
 def snapshot() -> dict:
     """Point-in-time copy of every store (JSON-serializable except
     sub_timers' tuple keys, which stringify as 'parent/name')."""

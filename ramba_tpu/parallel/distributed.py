@@ -28,6 +28,23 @@ import numpy as np
 _initialized = False
 
 
+def _init_kwargs(kwargs: dict) -> dict:
+    """Fold ``RAMBA_INIT_TIMEOUT_S`` into the ``jax.distributed.initialize``
+    kwargs (as ``initialization_timeout``, seconds).  An explicit kwarg
+    from the caller wins; a malformed or non-positive env value is
+    ignored."""
+    out = dict(kwargs)
+    raw = os.environ.get("RAMBA_INIT_TIMEOUT_S")
+    if raw:
+        try:
+            t = float(raw)
+        except ValueError:
+            t = 0.0
+        if t > 0:
+            out.setdefault("initialization_timeout", int(max(1, round(t))))
+    return out
+
+
 def initialize(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -70,25 +87,58 @@ def initialize(
         pass
     import time
 
+    from ramba_tpu.observe import health as _health
+    from ramba_tpu.resilience import faults as _faults
+    from ramba_tpu.resilience import retry as _retry
+
     t0 = time.perf_counter()
+    kw = _init_kwargs(kwargs)
+
+    # CPU multi-controller needs a cross-process collectives backend: with
+    # jax's default ("none") the group forms and compiles, then every
+    # cross-process computation fails at dispatch ("Multiprocess
+    # computations aren't implemented on the CPU backend").  Selecting
+    # gloo here — before the backend exists — makes bring-up on CPU
+    # clusters (and the 2-process CI legs) actually executable; TPU
+    # backends ignore it.
     try:
+        from jax._src import xla_bridge as _xb
+
+        if _xb.CPU_COLLECTIVES_IMPLEMENTATION.value == "none":
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (ImportError, AttributeError):
+        pass  # older/newer jax without this option
+
+    def connect():
+        _faults.check("init_connect")
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
-            **kwargs,
+            **kw,
         )
-    except Exception as e:
-        from ramba_tpu.observe import health as _health
 
+    def cleanup():
+        # a half-formed distributed client must be torn down before the
+        # next connect attempt can bind the coordinator channel again
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+
+    try:
+        _retry.call("init_connect", connect, on_retry=cleanup)
+    except Exception as e:
+        # Health event first, then re-raise WITH the original failure
+        # chained (RetryBudgetExhausted carries the last connect error as
+        # __cause__) — bring-up failures must never lose their root cause.
         _health.record(
             outcome="error", error=repr(e), source="distributed_init",
             init_seconds=time.perf_counter() - t0,
+            cause=repr(e.__cause__) if e.__cause__ is not None else None,
         )
         raise
     _initialized = True
-    from ramba_tpu.observe import health as _health
-
     _health.record(
         outcome="ok", source="distributed_init",
         init_seconds=time.perf_counter() - t0,

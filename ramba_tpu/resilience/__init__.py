@@ -1,0 +1,30 @@
+"""Resilience layer: the control plane that ACTS on the observe/ signals.
+
+PR 1 built the observability plane (``ramba_tpu/observe``: flush spans,
+counters, health events).  This package is the part of the system that
+turns those signals into recovery instead of a crash:
+
+* ``faults``  — deterministic fault-injection harness (``RAMBA_FAULTS``
+  env grammar + context managers) so every recovery path below is
+  testable on a laptop, byte-for-byte reproducibly, including in
+  multi-controller SPMD where BOTH ranks must take the same path.
+* ``retry``   — retry policy engine: exponential backoff + deterministic
+  jitter, per-site budgets (``RAMBA_RETRY_*``), and classification of
+  retryable vs. degrade-worthy vs. fatal errors.  Wrapped around fused
+  kernel compile+execute, Orbax checkpoint I/O, fileio reads/writes, and
+  ``jax.distributed.initialize``.
+* ``degrade`` — the graceful-degradation ladder for kernel execution:
+  fused → split (smaller jit segments) → eager (per-op, no jit) → host
+  (CPU backend), each step emitted as a ``degrade`` event and counter so
+  ``scripts/trace_report.py`` can show a degradation timeline.
+
+Everything here is transparent when nothing fails: with ``RAMBA_FAULTS``
+unset and no real errors, zero ``resilience.*`` counters fire and the
+flush hot path pays one closure call and one try/except.
+"""
+
+from ramba_tpu.resilience import degrade, faults, retry  # noqa: F401
+from ramba_tpu.resilience.faults import (  # noqa: F401
+    InjectedFault, InjectedResourceExhausted,
+)
+from ramba_tpu.resilience.retry import RetryBudgetExhausted  # noqa: F401

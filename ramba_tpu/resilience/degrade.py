@@ -1,0 +1,79 @@
+"""Graceful-degradation ladder for kernel execution.
+
+When the fused path keeps failing — repeated compile faults, or a device
+OOM where re-attempting the identical program is pointless — execution
+walks down a ladder of progressively cheaper-to-satisfy strategies
+instead of crashing the program:
+
+    fused  →  split  →  eager  →  host
+
+* **fused**: the normal path — one jit-compiled program (possibly
+  auto-segmented by ``RAMBA_TPU_MAX_PROGRAM_INSTRS``).
+* **split**: the same program re-run through the segmented executor with
+  a halved segment size and no leaf donation — smaller XLA programs,
+  smaller peak live set.
+* **eager**: per-op dispatch with no jit at all.
+* **host**: the whole program interpreted on the CPU backend (device →
+  host fallback as a first-class path; only offered single-controller).
+
+Each rung transition is emitted as a ``degrade`` event and counter so
+``scripts/trace_report.py`` can show the degradation timeline; each rung
+itself runs under the retry engine, so transient failures are retried in
+place before the ladder moves at all.
+
+The ladder never hides programming errors: anything :func:`retry.classify`
+calls ``fatal`` (TypeError, KernelTraceError, ...) propagates unchanged
+from whichever rung hit it.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+from ramba_tpu.observe import events as _events
+from ramba_tpu.observe import registry as _registry
+from ramba_tpu.resilience import retry as _retry
+
+#: Canonical rung order for the flush ladder.
+LADDER = ("fused", "split", "eager", "host")
+
+
+def run_ladder(site: str, rungs: List[Tuple[str, Callable]], *,
+               leaf_check: Optional[Callable[[], bool]] = None):
+    """Try ``rungs`` (ordered ``(name, thunk)`` pairs) until one succeeds.
+
+    Each rung runs under ``retry.call(site, thunk)``.  Returns
+    ``(result, rung_name)``.  Moves down a rung only for degrade-class
+    failures (OOM, exhausted retry budgets); fatal errors raise from the
+    rung that hit them.  ``leaf_check`` (if given) must return True for
+    the ladder to continue — it guards against re-running a program whose
+    donated input buffers were already consumed by a failed attempt.
+    """
+    last: Optional[Exception] = None
+    prev_name: Optional[str] = None
+    for i, (name, thunk) in enumerate(rungs):
+        if i > 0:
+            _registry.inc("resilience.degrade_steps")
+            _registry.inc(f"resilience.degrade.{name}")
+            _events.emit({"type": "degrade", "site": site, "action": "rung",
+                          "from": prev_name, "to": name,
+                          "error": _retry._errstr(last) if last else None})
+        try:
+            out = _retry.call(site, thunk)
+        except Exception as e:
+            if _retry.classify(e) == "fatal":
+                raise
+            if leaf_check is not None and not leaf_check():
+                # Donated inputs are gone; a lower rung would recompute
+                # from deleted buffers.  Surface the real failure.
+                raise
+            last = e
+            prev_name = name
+            continue
+        if i > 0:
+            _registry.inc("resilience.degrade_recovered")
+            _events.emit({"type": "degrade", "site": site,
+                          "action": "recovered", "rung": name})
+        return out, name
+    assert last is not None
+    raise last

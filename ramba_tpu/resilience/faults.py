@@ -1,0 +1,265 @@
+"""Deterministic fault-injection harness.
+
+Recovery code that only runs when a TPU is preempted is recovery code
+that has never run.  This module lets every resilience path in the repo
+be driven on a laptop, deterministically, from one env var::
+
+    RAMBA_FAULTS="compile:0.5,checkpoint_io:once,oom:after=3"
+
+Grammar: a comma-separated list of ``site:mode`` specs.  Modes:
+
+* ``once``      fire on the first check of that site, then disarm
+* ``always``    fire on every check
+* ``<int N>``   fire on the first N checks
+* ``after=N``   fire on every check after the first N (checks 1..N pass)
+* ``<float p>`` fire with probability p per check — via a PRNG seeded
+  from ``RAMBA_FAULTS_SEED`` + site + call number, so the fire pattern
+  is a pure function of the seed.  Under multi-controller SPMD every
+  rank sees the same pattern and the ranks stay in collective lockstep.
+
+Sites are free-form strings; the ones wired into the codebase are
+``compile``, ``execute``, ``oom``, ``eager``, ``host``, ``rewrite``,
+``checkpoint_io``, ``fileio``, ``init_connect``.  The ``oom`` site (or a
+trailing ``:oom`` kind) raises :class:`InjectedResourceExhausted`, whose
+message carries the ``RESOURCE_EXHAUSTED`` marker the retry classifier
+keys on; a trailing ``:fatal`` kind raises a non-retryable fault.
+
+``check(site)`` is a near-no-op (one dict lookup on an empty dict) when
+no faults are configured, so call sites can stay unconditional.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import warnings
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+from ramba_tpu.observe import events as _events
+from ramba_tpu.observe import registry as _registry
+
+
+class InjectedFault(RuntimeError):
+    """A fault raised by the injection harness (transient by default)."""
+
+    retryable = True
+
+    def __init__(self, site: str, call: int, detail: str = ""):
+        self.site = site
+        self.call = call
+        msg = f"injected fault at site {site!r} (check #{call})"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+class InjectedResourceExhausted(InjectedFault):
+    """Simulated device OOM; classified as degrade-worthy, not retryable
+    in place (retrying the identical allocation would just OOM again)."""
+
+    retryable = False
+
+    def __init__(self, site: str, call: int):
+        super().__init__(site, call, "RESOURCE_EXHAUSTED: simulated out of memory")
+
+
+class InjectedFatalFault(InjectedFault):
+    """Injected programming-error stand-in; must propagate unretried."""
+
+    retryable = False
+
+
+class _Spec:
+    __slots__ = ("site", "mode", "kind", "n", "p", "calls", "fired")
+
+    def __init__(self, site: str, mode: str, kind: str,
+                 n: Optional[int] = None, p: Optional[float] = None):
+        self.site = site
+        self.mode = mode      # "once" | "always" | "count" | "after" | "prob"
+        self.kind = kind      # "transient" | "oom" | "fatal"
+        self.n = n
+        self.p = p
+        self.calls = 0
+        self.fired = 0
+
+
+_lock = threading.Lock()
+_specs: Dict[str, _Spec] = {}
+_seed = 0
+
+
+def _parse_one(chunk: str) -> _Spec:
+    parts = chunk.strip().split(":")
+    if len(parts) < 2 or not parts[0]:
+        raise ValueError(f"bad RAMBA_FAULTS spec {chunk!r}: want site:mode")
+    site = parts[0].strip()
+    mode = parts[1].strip()
+    kind = parts[2].strip().lower() if len(parts) > 2 else ""
+    if len(parts) > 3:
+        raise ValueError(f"bad RAMBA_FAULTS spec {chunk!r}: too many fields")
+    if kind not in ("", "oom", "fatal", "transient"):
+        raise ValueError(f"bad RAMBA_FAULTS kind {kind!r} in {chunk!r}")
+    if not kind:
+        kind = "oom" if site == "oom" else "transient"
+    if mode == "once":
+        return _Spec(site, "once", kind)
+    if mode == "always":
+        return _Spec(site, "always", kind)
+    if mode.startswith("after="):
+        return _Spec(site, "after", kind, n=int(mode[len("after="):]))
+    try:
+        n = int(mode)
+    except ValueError:
+        pass
+    else:
+        if n < 0:
+            raise ValueError(f"bad RAMBA_FAULTS count in {chunk!r}")
+        return _Spec(site, "count", kind, n=n)
+    try:
+        p = float(mode)
+    except ValueError:
+        raise ValueError(f"bad RAMBA_FAULTS mode {mode!r} in {chunk!r}") from None
+    if not 0.0 <= p <= 1.0:
+        raise ValueError(f"RAMBA_FAULTS probability out of [0,1] in {chunk!r}")
+    return _Spec(site, "prob", kind, p=p)
+
+
+def _parse(spec: Optional[str], strict: bool = True) -> Dict[str, _Spec]:
+    out: Dict[str, _Spec] = {}
+    if not spec:
+        return out
+    for chunk in spec.split(","):
+        if not chunk.strip():
+            continue
+        try:
+            sp = _parse_one(chunk)
+        except ValueError:
+            if strict:
+                raise
+            warnings.warn(f"ignoring malformed RAMBA_FAULTS chunk {chunk!r}")
+            continue
+        out[sp.site] = sp
+    return out
+
+
+def configure(spec: Optional[str], *, seed: Optional[int] = None,
+              strict: bool = True) -> None:
+    """Install a fault plan (replacing any previous one) and reset all
+    per-site call counters.  ``configure(None)`` disarms everything."""
+    global _specs, _seed
+    with _lock:
+        _specs = _parse(spec, strict=strict)
+        if seed is not None:
+            _seed = int(seed)
+        else:
+            try:
+                _seed = int(os.environ.get("RAMBA_FAULTS_SEED", "0") or 0)
+            except ValueError:
+                _seed = 0
+
+
+def reset() -> None:
+    """Re-arm from the environment (``RAMBA_FAULTS``/``RAMBA_FAULTS_SEED``),
+    dropping any programmatic configuration and all counters."""
+    configure(os.environ.get("RAMBA_FAULTS"), strict=False)
+
+
+def enabled() -> bool:
+    return bool(_specs)
+
+
+def stats() -> Dict[str, dict]:
+    """Per-site ``{"calls": n, "fired": m}`` for the current plan."""
+    with _lock:
+        return {s.site: {"calls": s.calls, "fired": s.fired}
+                for s in _specs.values()}
+
+
+def _should_fire(sp: _Spec) -> bool:
+    if sp.mode == "once":
+        return sp.fired == 0
+    if sp.mode == "always":
+        return True
+    if sp.mode == "count":
+        return sp.fired < (sp.n or 0)
+    if sp.mode == "after":
+        return sp.calls > (sp.n or 0)
+    # "prob": deterministic in (seed, site, call number) — identical across
+    # ranks and across reruns, which is the whole point.
+    rng = random.Random(f"{_seed}:{sp.site}:{sp.calls}")
+    return rng.random() < (sp.p or 0.0)
+
+
+def check(site: str, **ctx) -> None:
+    """Raise an injected fault if the plan says this check should fail.
+
+    No-op (and allocation-free) when no plan is armed or the site is not
+    named in it.
+    """
+    if not _specs:
+        return
+    with _lock:
+        sp = _specs.get(site)
+        if sp is None:
+            return
+        sp.calls += 1
+        if not _should_fire(sp):
+            return
+        sp.fired += 1
+        call = sp.calls
+        kind = sp.kind
+        mode = sp.mode
+    _registry.inc("resilience.fault_injected")
+    _registry.inc(f"resilience.fault_injected.{site}")
+    ev = {"type": "fault", "site": site, "call": call, "mode": mode,
+          "kind": kind}
+    ev.update(ctx)
+    _events.emit(ev)
+    if kind == "oom":
+        raise InjectedResourceExhausted(site, call)
+    if kind == "fatal":
+        raise InjectedFatalFault(site, call, "injected fatal")
+    raise InjectedFault(site, call)
+
+
+@contextmanager
+def inject(site: str, mode: str = "once", *, kind: str = ""):
+    """Temporarily arm one site (on top of whatever is configured)::
+
+        with faults.inject("compile", "once"):
+            flush()
+    """
+    sp = _parse_one(f"{site}:{mode}:{kind}" if kind else f"{site}:{mode}")
+    with _lock:
+        prev = _specs.get(site)
+        _specs[site] = sp
+    try:
+        yield sp
+    finally:
+        with _lock:
+            if prev is not None:
+                _specs[site] = prev
+            else:
+                _specs.pop(site, None)
+
+
+@contextmanager
+def active(spec: str, *, seed: Optional[int] = None):
+    """Temporarily install a full fault plan, restoring the old one after."""
+    global _specs, _seed
+    with _lock:
+        prev_specs, prev_seed = _specs, _seed
+    configure(spec, seed=seed)
+    try:
+        yield
+    finally:
+        with _lock:
+            _specs, _seed = prev_specs, prev_seed
+
+
+# Arm from the environment at import so `RAMBA_FAULTS=... python app.py`
+# works with no code changes.  Malformed env chunks warn instead of
+# raising: a typo in an env var must not take the import down.
+reset()

@@ -1,0 +1,204 @@
+"""Retry policy engine: backoff + jitter, per-site budgets, classification.
+
+Every wrapped call site (``flush`` compile/execute, checkpoint I/O,
+fileio reads/writes, ``distributed.initialize``) funnels through
+:func:`call`, which:
+
+1. classifies each failure as ``retryable`` / ``degrade`` / ``fatal``
+   (:func:`classify`) — programming errors propagate unchanged so
+   existing error-path behavior is untouched; device-memory exhaustion
+   is pointless to retry identically and is handed to the degradation
+   ladder instead;
+2. sleeps exponential backoff with *deterministic* jitter (a hash of
+   seed × site × attempt, not wall-clock randomness) so multi-controller
+   ranks back off identically and reruns reproduce;
+3. gives up after the per-site attempt budget with
+   :class:`RetryBudgetExhausted`, chaining the last real error
+   (``__cause__``) so nothing is swallowed.
+
+Budgets and timing come from the environment, read per call (cheap, and
+monkeypatch-friendly):
+
+* ``RAMBA_RETRY_ATTEMPTS``        total attempts per site (default 3)
+* ``RAMBA_RETRY_<SITE>_ATTEMPTS`` per-site override (site uppercased,
+  non-alphanumerics → ``_``; e.g. ``RAMBA_RETRY_INIT_CONNECT_ATTEMPTS``)
+* ``RAMBA_RETRY_BASE_S``          first backoff delay (default 0.05)
+* ``RAMBA_RETRY_MAX_S``           delay ceiling (default 2.0)
+* ``RAMBA_RETRY_JITTER``          fractional jitter, 0..1 (default 0.5)
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import time
+from typing import Callable, Optional
+
+from ramba_tpu.observe import events as _events
+from ramba_tpu.observe import health as _health
+from ramba_tpu.observe import registry as _registry
+from ramba_tpu.resilience import faults as _faults
+
+
+class RetryBudgetExhausted(RuntimeError):
+    """All attempts at a site failed; ``__cause__`` holds the last error."""
+
+
+# Matched case-sensitively: gRPC/XLA status codes come through uppercase,
+# and matching lowercase English ("unavailable", "aborted") would
+# misclassify ordinary error prose — e.g. skeletons' "host fallback is
+# unavailable under multi-controller execution" must stay fatal.
+_RETRYABLE_MARKERS = (
+    "DEADLINE_EXCEEDED", "UNAVAILABLE", "ABORTED", "CANCELLED", "INTERNAL: ",
+    "Connection refused", "Connection reset", "Broken pipe",
+    "Socket closed", "connection attempt timed out",
+)
+_DEGRADE_MARKERS = (
+    "RESOURCE_EXHAUSTED", "out of memory", "Out of memory", "OutOfMemory",
+    "Resource exhausted",
+)
+# I/O errors where a retry cannot possibly change the outcome.
+_FATAL_OS_ERRORS = (
+    FileNotFoundError, IsADirectoryError, NotADirectoryError,
+    PermissionError, FileExistsError,
+)
+
+
+def classify(exc: BaseException) -> str:
+    """Sort an exception into ``"retryable"`` (back off and re-attempt in
+    place), ``"degrade"`` (re-attempting identically is pointless — move
+    down the ladder), or ``"fatal"`` (propagate unchanged)."""
+    if isinstance(exc, RetryBudgetExhausted):
+        return "degrade"
+    if isinstance(exc, _faults.InjectedResourceExhausted):
+        return "degrade"
+    if isinstance(exc, _faults.InjectedFault):
+        return "retryable" if exc.retryable else "fatal"
+    if isinstance(exc, _FATAL_OS_ERRORS):
+        return "fatal"
+    if isinstance(exc, (OSError, TimeoutError, ConnectionError)):
+        return "retryable"
+    msg = str(exc)
+    for marker in _DEGRADE_MARKERS:
+        if marker in msg:
+            return "degrade"
+    for marker in _RETRYABLE_MARKERS:
+        if marker in msg:
+            return "retryable"
+    return "fatal"
+
+
+def is_retryable(exc: BaseException) -> bool:
+    return classify(exc) == "retryable"
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+def _site_env(site: str) -> str:
+    return "".join(c if c.isalnum() else "_" for c in site.upper())
+
+
+class RetryPolicy:
+    __slots__ = ("attempts", "base_s", "max_s", "jitter", "seed")
+
+    def __init__(self, attempts: int = 3, base_s: float = 0.05,
+                 max_s: float = 2.0, jitter: float = 0.5, seed: int = 0):
+        self.attempts = max(1, int(attempts))
+        self.base_s = max(0.0, float(base_s))
+        self.max_s = max(0.0, float(max_s))
+        self.jitter = min(1.0, max(0.0, float(jitter)))
+        self.seed = int(seed)
+
+    def delay(self, site: str, attempt: int) -> float:
+        """Backoff before re-attempt number ``attempt`` (1-based): capped
+        exponential, jittered by a deterministic ±jitter/2 fraction."""
+        base = min(self.max_s, self.base_s * (2.0 ** (attempt - 1)))
+        if base <= 0.0:
+            return 0.0
+        if self.jitter <= 0.0:
+            return base
+        rng = random.Random(f"{self.seed}:{site}:{attempt}")
+        frac = 1.0 + self.jitter * (rng.random() - 0.5)
+        return base * frac
+
+
+def policy_for(site: str) -> RetryPolicy:
+    attempts = _env_int(f"RAMBA_RETRY_{_site_env(site)}_ATTEMPTS",
+                        _env_int("RAMBA_RETRY_ATTEMPTS", 3))
+    return RetryPolicy(
+        attempts=attempts,
+        base_s=_env_float("RAMBA_RETRY_BASE_S", 0.05),
+        max_s=_env_float("RAMBA_RETRY_MAX_S", 2.0),
+        jitter=_env_float("RAMBA_RETRY_JITTER", 0.5),
+        seed=_env_int("RAMBA_FAULTS_SEED", 0),
+    )
+
+
+def _errstr(exc: BaseException) -> str:
+    return f"{type(exc).__name__}: {exc}"[:300]
+
+
+def call(site: str, fn: Callable, *, on_retry: Optional[Callable] = None,
+         policy: Optional[RetryPolicy] = None):
+    """Run ``fn()`` under the site's retry policy.
+
+    Retryable failures back off and re-attempt (running ``on_retry``
+    between attempts, e.g. to tear down a half-formed client); anything
+    else propagates unchanged.  When the budget runs out the last error
+    is chained under :class:`RetryBudgetExhausted`.  A recovery after
+    ≥1 retry is recorded in the health stream.
+    """
+    pol = policy or policy_for(site)
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            out = fn()
+        except Exception as e:
+            if classify(e) != "retryable":
+                raise
+            if attempt >= pol.attempts:
+                _registry.inc("resilience.retry_exhausted")
+                _registry.inc(f"resilience.retry_exhausted.{site}")
+                _events.emit({"type": "degrade", "site": site,
+                              "action": "exhausted", "attempts": attempt,
+                              "error": _errstr(e)})
+                raise RetryBudgetExhausted(
+                    f"{site}: {attempt} attempt(s) failed; retry budget "
+                    f"exhausted (last: {_errstr(e)})"
+                ) from e
+            delay = pol.delay(site, attempt)
+            _registry.inc("resilience.retries")
+            _registry.inc(f"resilience.retries.{site}")
+            _events.emit({"type": "degrade", "site": site, "action": "retry",
+                          "attempt": attempt, "delay_s": round(delay, 4),
+                          "error": _errstr(e)})
+            if on_retry is not None:
+                try:
+                    on_retry()
+                except Exception:
+                    pass
+            if delay > 0:
+                time.sleep(delay)
+            continue
+        if attempt > 1:
+            _health.record_recovery(site, attempt - 1)
+        return out

@@ -29,6 +29,7 @@ TPU-native design:
 
 from __future__ import annotations
 
+import threading
 import warnings
 from typing import Callable
 
@@ -361,7 +362,37 @@ def _split_operands(args):
     return slots, operands
 
 
-_host_fallback_warned = False
+# Once-per-KERNEL host-fallback warning state.  A module-global boolean
+# would warn for the first offending kernel only — every later kernel
+# that silently falls off the device would go unreported — and two
+# threads racing the flag could drop the warning entirely.
+_fallback_warn_lock = threading.Lock()
+_fallback_warned_kernels: set = set()
+
+
+def _warn_host_fallback_once(func) -> bool:
+    """True exactly once per kernel (thread-safe) — the caller should warn."""
+    try:
+        with _fallback_warn_lock:
+            if func in _fallback_warned_kernels:
+                return False
+            _fallback_warned_kernels.add(func)
+            return True
+    except TypeError:  # unhashable callable: warn every time
+        return True
+
+
+def fallback_warned_kernels() -> frozenset:
+    """Kernels that have taken (and warned about) the host fallback."""
+    with _fallback_warn_lock:
+        return frozenset(_fallback_warned_kernels)
+
+
+def reset_fallback_warnings() -> None:
+    """Test-visible reset hook: re-arm the once-per-kernel warning so a
+    repeated suite (or a fresh test) observes it again."""
+    with _fallback_warn_lock:
+        _fallback_warned_kernels.clear()
 
 
 def _host_smap(func, slots, with_index, ndim, arrs):
@@ -382,13 +413,12 @@ def _host_smap(func, slots, with_index, ndim, arrs):
             "multi-controller execution; rewrite the kernel with "
             "np.where/jnp.where/lax.cond"
         )
-    global _host_fallback_warned
-    if not _host_fallback_warned:
-        _host_fallback_warned = True
+    if _warn_host_fallback_once(func):
         warnings.warn(
-            "smap kernel is not jax-traceable (data-dependent branching); "
-            "falling back to per-element host evaluation. Rewrite the branch "
-            "with np.where/jnp.where for TPU-speed execution."
+            f"smap kernel {getattr(func, '__name__', repr(func))} is not "
+            "jax-traceable (data-dependent branching); falling back to "
+            "per-element host evaluation. Rewrite the branch with "
+            "np.where/jnp.where for TPU-speed execution."
         )
     shape = np.broadcast_shapes(*[tuple(a.shape) for a in arrs]) if arrs else ()
 

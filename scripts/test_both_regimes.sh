@@ -8,3 +8,5 @@ echo "=== leg 1: x64 (NumPy-exact) ==="
 python -m pytest tests/ -q "$@"
 echo "=== leg 2: x32 (TPU numerics) ==="
 RAMBA_TEST_X64=0 python -m pytest tests/ -q "$@"
+echo "=== leg 3: 2-process fault injection (RAMBA_FAULTS=compile:once) ==="
+python scripts/two_process_suite.py --fault-leg

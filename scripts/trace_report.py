@@ -13,7 +13,9 @@ Prints, per input:
   * health records (platform, device count, init time, fallback reasons),
   * flush totals: count, wall time, compile vs execute split, cache hit
     rate, instructions, bytes in (leaves) and out (roots),
-  * rewrite-rule fire totals, and
+  * rewrite-rule fire totals,
+  * the degradation timeline (injected faults, retries, ladder rung
+    transitions fused→split→eager→host, recoveries — newest last), and
   * the top programs by cumulative wall time.
 """
 
@@ -72,6 +74,8 @@ def report(path: str, events: list, top: int = 10, file=None) -> None:
         if h.get("error"):
             print(f"  error: {h['error']}", file=file)
 
+    _degradation_timeline(events, file=file)
+
     flushes = [e for e in events if e.get("type") == "flush"]
     if not flushes:
         print("no flush spans", file=file)
@@ -126,6 +130,53 @@ def report(path: str, events: list, top: int = 10, file=None) -> None:
             f"  {label:<18s} {w:10.4f}s  x{cnt:<5d} compile {comp:.4f}s",
             file=file,
         )
+
+
+def _degradation_timeline(events: list, file=None, cap: int = 50) -> None:
+    """Chronological fault/retry/degradation lines, timestamped relative to
+    the first event in the trace."""
+    file = file or sys.stdout
+    degr = [e for e in events if e.get("type") in ("fault", "degrade")]
+    if not degr:
+        return
+    stamps = [e["ts"] for e in events if isinstance(e.get("ts"), (int, float))]
+    t0 = min(stamps) if stamps else None
+    print(f"degradation timeline ({len(degr)} events):", file=file)
+    for e in degr[:cap]:
+        rel = (f"+{e['ts'] - t0:8.3f}s"
+               if t0 is not None and isinstance(e.get("ts"), (int, float))
+               else " " * 10)
+        if e["type"] == "fault":
+            line = (f"fault     {e.get('site', '?')} "
+                    f"call={e.get('call', '?')} mode={e.get('mode', '?')}")
+        else:
+            action = e.get("action", "?")
+            site = e.get("site", "?")
+            if action == "retry":
+                line = (f"retry     {site} attempt={e.get('attempt', '?')} "
+                        f"delay={e.get('delay_s', 0)}s")
+            elif action == "exhausted":
+                line = (f"exhausted {site} "
+                        f"attempts={e.get('attempts', '?')}")
+            elif action == "rung":
+                line = (f"degrade   {site} "
+                        f"{e.get('from', '?')} -> {e.get('to', '?')}")
+            elif action == "recovered":
+                line = f"recovered {site} rung={e.get('rung', '?')}"
+            else:
+                line = f"{action} {site}"
+            if e.get("error"):
+                line += f"  ({str(e['error'])[:80]})"
+        print(f"  {rel}  {line}", file=file)
+    if len(degr) > cap:
+        print(f"  ... and {len(degr) - cap} more", file=file)
+    retries = sum(1 for e in degr
+                  if e.get("type") == "degrade" and e.get("action") == "retry")
+    rungs = sum(1 for e in degr
+                if e.get("type") == "degrade" and e.get("action") == "rung")
+    faults = sum(1 for e in degr if e.get("type") == "fault")
+    print(f"degradation totals: faults={faults} retries={retries} "
+          f"rung-steps={rungs}", file=file)
 
 
 def main(argv=None) -> int:

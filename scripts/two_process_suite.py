@@ -18,8 +18,15 @@ single writer per file.
 Usage:
     python scripts/two_process_suite.py [pytest args...]
     # e.g. python scripts/two_process_suite.py tests/test_fusion.py -x
+    python scripts/two_process_suite.py --fault-leg
 
 Exit 0 iff BOTH ranks' pytest runs pass.
+
+``--fault-leg`` runs the resilience acceptance leg instead: a 2-rank SPMD
+workload under ``RAMBA_FAULTS=compile:once`` — both ranks must inject the
+fault in lockstep, retry the flush, produce the correct result, count
+``resilience.retries`` >= 1, and stream fault/degrade events into their
+per-rank RAMBA_TRACE files.
 """
 
 from __future__ import annotations
@@ -34,8 +41,117 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# SPMD workload for the fault leg: each rank forms the process group
+# itself (no pytest/conftest in the loop), runs a fused chain that must
+# survive one injected compile fault per rank, and checks its own retry
+# counters.  argv: <rank> <coordinator>.
+_FAULT_WORKLOAD = """
+import sys
+import numpy as np
+rank, coord = int(sys.argv[1]), sys.argv[2]
+from ramba_tpu.parallel import distributed
+distributed.initialize(coordinator_address=coord, num_processes=2,
+                       process_id=rank)
+import jax
+assert jax.process_count() == 2, jax.process_count()
+import ramba_tpu as rt
+a = rt.arange(4096) * 2.0 + 1.0
+s = float(rt.sum(a))
+exp = float(np.sum(np.arange(4096) * 2.0 + 1.0))
+assert abs(s - exp) <= 1e-5 * abs(exp), (s, exp)
+from ramba_tpu import diagnostics
+c = diagnostics.counters()
+assert c.get('resilience.retries', 0) >= 1, c
+print('FAULT_LEG_OK rank=%d retries=%d' % (rank, c['resilience.retries']))
+"""
+
+
+def run_fault_leg() -> int:
+    """Two ranks, one injected compile fault each; both must recover."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    basetemp = tempfile.mkdtemp(prefix="ramba_2proc_fault_")
+    trace_base = os.path.join(basetemp, "trace.jsonl")
+    budget = float(os.environ.get("RAMBA_TEST_PROCS_TIMEOUT", "600"))
+
+    procs, logs = [], []
+    for rank in range(2):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        for k in ("RAMBA_TEST_PROCS", "RAMBA_TEST_PROC_ID",
+                  "RAMBA_TEST_COORD", "RAMBA_TEST_SHARED_TMP",
+                  "RAMBA_PROFILE_DIR"):
+            env.pop(k, None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["RAMBA_FAULTS"] = "compile:once"
+        env["RAMBA_RETRY_BASE_S"] = "0.01"
+        env["RAMBA_TRACE"] = trace_base
+        log = open(os.path.join(basetemp, f"rank{rank}.log"), "w")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _FAULT_WORKLOAD, str(rank),
+             f"localhost:{port}"],
+            env=env, stdout=log, stderr=subprocess.STDOUT, cwd=REPO,
+        ))
+
+    deadline = time.time() + budget
+    rcs = [None, None]
+    try:
+        for i, p in enumerate(procs):
+            left = max(5.0, deadline - time.time())
+            try:
+                rcs[i] = p.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                rcs[i] = -9
+    finally:
+        for log in logs:
+            log.close()
+
+    ok = all(rc == 0 for rc in rcs)
+
+    # Per-rank traces must show the injected fault AND the retry that
+    # absorbed it — the degradation timeline works under SPMD.
+    import json
+
+    for rank in range(2):
+        path = f"{trace_base}.rank{rank}"
+        try:
+            with open(path) as f:
+                evs = [json.loads(ln) for ln in f if ln.strip()]
+            n_fault = sum(1 for e in evs if e.get("type") == "fault"
+                          and e.get("site") == "compile")
+            n_retry = sum(1 for e in evs if e.get("type") == "degrade"
+                          and e.get("action") == "retry")
+            print(f"fault leg rank {rank}: {len(evs)} events, "
+                  f"{n_fault} faults, {n_retry} retries")
+            if n_fault == 0 or n_retry == 0:
+                print(f"fault leg rank {rank}: FAIL "
+                      f"(fault={n_fault}, retry={n_retry})")
+                ok = False
+        except (OSError, ValueError) as e:
+            print(f"fault leg rank {rank}: FAIL ({e})")
+            ok = False
+
+    for rank in range(2):
+        path = os.path.join(basetemp, f"rank{rank}.log")
+        with open(path) as f:
+            tail = f.read().splitlines()
+        if "FAULT_LEG_OK rank=%d" % rank not in "\n".join(tail):
+            ok = False
+        print(f"--- fault leg rank {rank} rc={rcs[rank]} ({path}) ---")
+        print("\n".join(tail[-(4 if ok else 40):]))
+    print(f"two-process fault leg: {'OK' if ok else 'FAIL'}")
+    if ok:
+        shutil.rmtree(basetemp, ignore_errors=True)
+    return 0 if ok else 1
+
 
 def main() -> int:
+    if "--fault-leg" in sys.argv[1:]:
+        return run_fault_leg()
     pytest_args = sys.argv[1:] or ["tests/"]
     with socket.socket() as s:
         s.bind(("localhost", 0))

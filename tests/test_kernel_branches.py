@@ -24,7 +24,7 @@ import ramba_tpu as rt
 def _no_host_fallback():
     from ramba_tpu import skeletons
 
-    skeletons._host_fallback_warned = False
+    skeletons.reset_fallback_warnings()
     return skeletons
 
 
@@ -39,7 +39,7 @@ def test_smap_branching_kernel_stays_on_device():
     # fallback, no warning
     skeletons = _no_host_fallback()
     np.asarray(rt.smap(lambda x: 1.0 if x > 0 else 0.0, [-1.0, 1.0]))
-    assert not skeletons._host_fallback_warned
+    assert not skeletons.fallback_warned_kernels()
 
 
 def test_smap_branching_sharded():
@@ -70,7 +70,7 @@ def test_smap_nested_and_elif_branches():
     skeletons = _no_host_fallback()
     r = rt.smap(k, x)
     np.testing.assert_allclose(np.asarray(r), want, rtol=1e-12)
-    assert not skeletons._host_fallback_warned
+    assert not skeletons.fallback_warned_kernels()
 
 
 def test_smap_traceable_kernel_stays_on_device():
@@ -79,7 +79,7 @@ def test_smap_traceable_kernel_stays_on_device():
     x = np.linspace(-1, 1, 64)
     r = rt.smap(lambda v: np.where(v > 0, v * 2, -v), x)
     np.testing.assert_allclose(np.asarray(r), np.where(x > 0, x * 2, -x))
-    assert not skeletons._host_fallback_warned
+    assert not skeletons.fallback_warned_kernels()
 
 
 def test_smap_index_branching():
@@ -133,7 +133,7 @@ def test_smap_branch_on_wide_values_on_device():
     skeletons = _no_host_fallback()
     r = rt.smap(lambda x: x / 2 if abs(x) > 10 else 0, [1.0, 100.0])
     np.testing.assert_allclose(np.asarray(r), [0.0, 50.0])
-    assert not skeletons._host_fallback_warned
+    assert not skeletons.fallback_warned_kernels()
 
 
 import jax as _jax
@@ -262,6 +262,8 @@ def test_scumulative_branching_runs_on_device():
     np.testing.assert_allclose(got, np.array(want))
 
 
+@pytest.mark.slow  # the host path is a per-element Python loop over 3M
+# elements — minutes of wall clock on small CI machines; run via -m slow
 @pytest.mark.skipif(
     _MULTIPROC,
     reason="pure_callback reference timing needs the single-controller "

@@ -1,0 +1,604 @@
+"""Resilience layer: fault injection, retry policies, degradation ladder.
+
+Covers ``ramba_tpu.resilience`` plus its integrations:
+
+* deterministic fault injection (``RAMBA_FAULTS`` grammar, seeded
+  probability modes with reproducible fire patterns),
+* retry engine: budgets, exponential backoff determinism, retryable vs
+  degrade vs fatal classification, budget exhaustion with the original
+  error chained,
+* the flush degradation ladder fused → split → eager → host with
+  counters asserted via ``observe.registry`` and the degraded rung
+  recorded in the flush span,
+* atomic checkpointing (a crashed save never corrupts the published
+  checkpoint; ``CheckpointCorruptError`` on unreadable/mismatched
+  restores),
+* fileio read retries, skeletons' once-per-kernel host-fallback warning,
+  and ``distributed.initialize`` connect retry (subprocess),
+* the acceptance workload: ``RAMBA_FAULTS=compile:once`` in a subprocess
+  completes correctly with ``resilience.retries`` >= 1 and a degradation
+  event in the ``RAMBA_TRACE`` JSONL that ``trace_report.py`` renders.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import jax as _jax
+import ramba_tpu as rt
+from ramba_tpu import common, diagnostics
+from ramba_tpu.core import fuser
+from ramba_tpu.observe import registry
+from ramba_tpu.resilience import faults, retry
+
+_MULTIPROC = _jax.process_count() > 1
+
+
+@pytest.fixture(autouse=True)
+def _fast_clean_faults(monkeypatch):
+    """No leaked fault plans between tests, and near-zero backoff so
+    retry-path tests stay fast."""
+    monkeypatch.setenv("RAMBA_RETRY_BASE_S", "0.001")
+    faults.configure(None)
+    yield
+    faults.reset()  # re-arm from env (unset in tier-1 -> disarmed)
+
+
+def _fires(site, n):
+    out = []
+    for _ in range(n):
+        try:
+            faults.check(site)
+            out.append(False)
+        except faults.InjectedFault:
+            out.append(True)
+    return out
+
+
+# -- faults.py ---------------------------------------------------------------
+
+
+def test_fault_modes():
+    faults.configure("a:once,b:2,c:after=2,d:always")
+    assert _fires("a", 3) == [True, False, False]
+    assert _fires("b", 4) == [True, True, False, False]
+    assert _fires("c", 4) == [False, False, True, True]
+    assert _fires("d", 3) == [True, True, True]
+    assert _fires("unarmed", 2) == [False, False]
+    st = faults.stats()
+    assert st["a"] == {"calls": 3, "fired": 1}
+    assert st["d"] == {"calls": 3, "fired": 3}
+    faults.configure(None)
+    assert not faults.enabled()
+
+
+def test_probability_mode_is_deterministic():
+    def pattern(seed):
+        faults.configure("p:0.5", seed=seed)
+        return _fires("p", 100)
+
+    p1, p2 = pattern(7), pattern(7)
+    assert p1 == p2, "same seed must reproduce the exact fire pattern"
+    assert 20 <= sum(p1) <= 80, f"p=0.5 fired {sum(p1)}/100 times"
+    assert pattern(8) != p1, "different seed must change the pattern"
+
+
+def test_bad_spec_rejected_strict_warned_from_env():
+    with pytest.raises(ValueError):
+        faults.configure("compile")  # no mode
+    with pytest.raises(ValueError):
+        faults.configure("compile:sometimes")
+    with pytest.raises(ValueError):
+        faults.configure("compile:1.5")  # probability out of range
+    with pytest.warns(UserWarning, match="malformed"):
+        faults.configure("compile:sometimes,execute:once", strict=False)
+    assert _fires("execute", 1) == [True]  # good chunk still armed
+
+
+def test_oom_site_and_kinds():
+    faults.configure("oom:once")
+    with pytest.raises(faults.InjectedResourceExhausted,
+                       match="RESOURCE_EXHAUSTED"):
+        faults.check("oom")
+    faults.configure("x:once:fatal")
+    with pytest.raises(faults.InjectedFault) as ei:
+        faults.check("x")
+    assert not ei.value.retryable
+
+
+def test_inject_context_restores_previous_plan():
+    faults.configure("compile:always")
+    with faults.inject("compile", "once"):
+        assert _fires("compile", 2) == [True, False]
+    assert _fires("compile", 2) == [True, True]  # "always" restored
+    faults.configure(None)
+    with faults.inject("execute", "once"):
+        assert _fires("execute", 1) == [True]
+    assert not faults.enabled()
+
+
+# -- retry.py ----------------------------------------------------------------
+
+
+def test_classify():
+    assert retry.classify(ValueError("bad operand")) == "fatal"
+    assert retry.classify(TypeError("no")) == "fatal"
+    assert retry.classify(TimeoutError("slow")) == "retryable"
+    assert retry.classify(ConnectionResetError()) == "retryable"
+    assert retry.classify(FileNotFoundError("gone")) == "fatal"
+    assert retry.classify(PermissionError("no")) == "fatal"
+    assert retry.classify(OSError("disk hiccup")) == "retryable"
+    assert retry.classify(
+        RuntimeError("RESOURCE_EXHAUSTED: out of memory allocating")
+    ) == "degrade"
+    assert retry.classify(RuntimeError("UNAVAILABLE: socket closed")) \
+        == "retryable"
+    # lowercase English prose must NOT look like a gRPC status code
+    assert retry.classify(
+        RuntimeError("the host fallback is unavailable under "
+                     "multi-controller execution")
+    ) == "fatal"
+    assert retry.classify(retry.RetryBudgetExhausted("x")) == "degrade"
+    assert retry.classify(faults.InjectedFault("s", 1)) == "retryable"
+    assert retry.classify(faults.InjectedResourceExhausted("s", 1)) \
+        == "degrade"
+
+
+def test_retry_recovers_and_records_health():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise TimeoutError("transient blip")
+        return "ok"
+
+    before = registry.get("resilience.retries.unit_site")
+    assert retry.call("unit_site", flaky) == "ok"
+    assert calls["n"] == 3
+    assert registry.get("resilience.retries.unit_site") == before + 2
+    hs = [e for e in diagnostics.health_events(50)
+          if e.get("source") == "unit_site"]
+    assert hs and hs[-1]["outcome"] == "recovered" \
+        and hs[-1]["retries"] == 2
+
+
+def test_retry_budget_exhausted_chains_cause(monkeypatch):
+    monkeypatch.setenv("RAMBA_RETRY_UNIT_X_ATTEMPTS", "2")
+    calls = {"n": 0}
+
+    def always_down():
+        calls["n"] += 1
+        raise TimeoutError("still down")
+
+    before = registry.get("resilience.retry_exhausted.unit.x")
+    with pytest.raises(retry.RetryBudgetExhausted) as ei:
+        retry.call("unit.x", always_down)
+    assert calls["n"] == 2, "per-site env budget must cap attempts"
+    assert isinstance(ei.value.__cause__, TimeoutError)
+    assert "still down" in str(ei.value)
+    assert registry.get("resilience.retry_exhausted.unit.x") == before + 1
+
+
+def test_fatal_error_not_retried():
+    calls = {"n": 0}
+
+    def broken():
+        calls["n"] += 1
+        raise ValueError("programming error")
+
+    before = registry.get("resilience.retries")
+    with pytest.raises(ValueError, match="programming error"):
+        retry.call("unit_fatal", broken)
+    assert calls["n"] == 1, "fatal errors must propagate unretried"
+    assert registry.get("resilience.retries") == before
+
+
+def test_backoff_deterministic_and_capped():
+    pol = retry.RetryPolicy(attempts=6, base_s=0.1, max_s=0.3,
+                            jitter=0.5, seed=7)
+    d1 = [pol.delay("site", a) for a in range(1, 6)]
+    assert d1 == [pol.delay("site", a) for a in range(1, 6)]
+    assert all(d <= 0.3 * 1.25 + 1e-12 for d in d1), d1
+    assert all(d > 0 for d in d1)
+    other = retry.RetryPolicy(attempts=6, base_s=0.1, max_s=0.3,
+                              jitter=0.5, seed=8)
+    assert d1[0] != other.delay("site", 1), "jitter must depend on the seed"
+    assert retry.RetryPolicy(base_s=0.0).delay("site", 1) == 0.0
+
+
+# -- the flush degradation ladder -------------------------------------------
+
+
+def _chain(scale, offset, n=1024):
+    a = rt.arange(n) * scale + offset
+    return float(rt.sum(a))
+
+
+def _expect(scale, offset, n=1024):
+    return float(np.sum(np.arange(n) * scale + offset))
+
+
+def test_flush_retries_through_injected_compile_fault():
+    fuser.flush()
+    fuser._compile_cache.clear()
+    before = registry.get("resilience.retries.flush")
+    with faults.inject("compile", "once"):
+        got = _chain(3.0, 2.0)
+    assert got == pytest.approx(_expect(3.0, 2.0), rel=1e-6)
+    assert registry.get("resilience.retries.flush") >= before + 1
+    evs = diagnostics.resilience_events(50)
+    assert any(e["type"] == "fault" and e["site"] == "compile" for e in evs)
+    assert any(e["type"] == "degrade" and e.get("action") == "retry"
+               and e.get("site") == "flush" for e in evs)
+    span = diagnostics.last_flushes(1)[0]
+    assert "degraded" not in span, "an in-place retry is not a rung change"
+
+
+def test_ladder_split_on_injected_oom():
+    fuser.flush()
+    fuser._compile_cache.clear()
+    before_steps = registry.get("resilience.degrade.split")
+    before_rec = registry.get("resilience.degrade_recovered")
+    with faults.inject("oom", "1"):
+        got = _chain(5.0, 7.0)
+    assert got == pytest.approx(_expect(5.0, 7.0), rel=1e-6)
+    assert registry.get("resilience.degrade.split") == before_steps + 1
+    assert registry.get("resilience.degrade_recovered") == before_rec + 1
+    span = diagnostics.last_flushes(1)[0]
+    assert span.get("degraded") == "split"
+    evs = diagnostics.resilience_events(50)
+    assert any(e.get("action") == "rung" and e.get("to") == "split"
+               for e in evs)
+    assert any(e.get("action") == "recovered" and e.get("rung") == "split"
+               for e in evs)
+
+
+@pytest.mark.skipif(_MULTIPROC, reason="deep rungs are asserted "
+                    "single-controller; multi-host keeps fused/split")
+def test_ladder_reaches_eager(monkeypatch):
+    monkeypatch.setenv("RAMBA_RETRY_ATTEMPTS", "2")
+    fuser.flush()
+    fuser._compile_cache.clear()
+    with faults.inject("compile", "always"):
+        got = _chain(3.5, 1.0)
+    assert got == pytest.approx(_expect(3.5, 1.0), rel=1e-6)
+    span = diagnostics.last_flushes(1)[0]
+    assert span.get("degraded") == "eager"
+    assert registry.get("resilience.degrade.eager") >= 1
+    # both the fused and split rungs exhausted their budgets first
+    assert registry.get("resilience.retry_exhausted.flush") >= 2
+
+
+@pytest.mark.skipif(_MULTIPROC, reason="host rung is single-controller only")
+def test_ladder_reaches_host(monkeypatch):
+    monkeypatch.setenv("RAMBA_RETRY_ATTEMPTS", "2")
+    fuser.flush()
+    fuser._compile_cache.clear()
+    with faults.active("compile:always,eager:always"):
+        got = _chain(2.0, -3.0, n=512)
+    assert got == pytest.approx(_expect(2.0, -3.0, n=512), rel=1e-6)
+    span = diagnostics.last_flushes(1)[0]
+    assert span.get("degraded") == "host"
+    assert registry.get("resilience.degrade.host") >= 1
+    evs = diagnostics.resilience_events(50)
+    rungs = [e.get("to") for e in evs if e.get("action") == "rung"]
+    assert "host" in rungs
+
+
+def test_fatal_flush_errors_skip_the_ladder():
+    # A fatal (programming) error must propagate unchanged from the fused
+    # rung — no retries, no rung transitions.
+    fuser.flush()
+    before = registry.prefixed("resilience.")
+    with faults.inject("compile", "once", kind="fatal"):
+        fuser._compile_cache.clear()
+        with pytest.raises(faults.InjectedFault):
+            _chain(9.0, 9.0)
+    after = registry.prefixed("resilience.")
+    # only injection + quarantine accounting moved; no retry/degrade
+    # counters fired
+    moved = {k for k in after if after[k] != before.get(k, 0)}
+    assert moved <= {"resilience.fault_injected",
+                     "resilience.fault_injected.compile",
+                     "resilience.flush_quarantined"}, moved
+
+
+def test_failed_flush_quarantines_roots():
+    # One broken pending expression must not poison every later flush:
+    # the failed program's roots leave the pending registry (counted as
+    # resilience.flush_quarantined), unrelated work proceeds untouched,
+    # and a quarantined array still materializes on demand by
+    # re-attempting its own graph alone.
+    fuser.flush()
+    a = rt.arange(256) * 3.0
+    fuser._compile_cache.clear()
+    before = registry.get("resilience.flush_quarantined")
+    with faults.inject("compile", "once", kind="fatal"):
+        with pytest.raises(faults.InjectedFault):
+            np.asarray(a)
+    assert registry.get("resilience.flush_quarantined") > before
+    # the pending registry no longer carries the failed program's roots,
+    # so an unrelated computation flushes cleanly
+    got = _chain(2.0, 1.0, n=128)
+    assert got == pytest.approx(_expect(2.0, 1.0, n=128), rel=1e-6)
+    # and the quarantined array self-heals when touched again (the fault
+    # was one-shot; its graph re-runs alone and succeeds)
+    np.testing.assert_allclose(np.asarray(a), np.arange(256) * 3.0)
+
+
+def test_no_faults_means_zero_resilience_counters():
+    fuser.flush()
+    before = registry.prefixed("resilience.")
+    got = _chain(1.5, -2.0, n=3000)
+    assert got == pytest.approx(_expect(1.5, -2.0, n=3000), rel=1e-6)
+    assert registry.prefixed("resilience.") == before
+
+
+def test_rewrite_crash_degrades_to_unrewritten_graph():
+    if not common.rewrite_enabled:
+        pytest.skip("rewrites disabled in this regime")
+    fuser.flush()
+    before = registry.get("resilience.rewrite_bypassed")
+    with faults.inject("rewrite", "once"):
+        out = np.asarray(rt.arange(64).reshape(8, 8))
+    np.testing.assert_allclose(out, np.arange(64).reshape(8, 8))
+    assert registry.get("resilience.rewrite_bypassed") == before + 1
+
+
+# -- checkpoint.py -----------------------------------------------------------
+
+
+def _ck(tmp_path, name):
+    return str(tmp_path / name)
+
+
+def test_checkpoint_failed_save_preserves_published(tmp_path, monkeypatch):
+    pytest.importorskip("orbax.checkpoint")
+    from ramba_tpu import checkpoint
+
+    monkeypatch.setenv("RAMBA_RETRY_ATTEMPTS", "2")
+    p = _ck(tmp_path, "ck_atomic")
+    w = rt.arange(100) * 1.0
+    checkpoint.save(p, {"w": w})
+    # crash-mid-write: every attempt of the re-save fails; the PUBLISHED
+    # checkpoint must keep the original contents
+    with faults.inject("checkpoint_io", "always"):
+        with pytest.raises(retry.RetryBudgetExhausted):
+            checkpoint.save(p, {"w": rt.arange(100) * 3.0}, force=True)
+    back = checkpoint.restore(p)
+    np.testing.assert_allclose(np.asarray(back["w"]), np.arange(100) * 1.0)
+    # crash debris at the staging path must not block the next save
+    junk = p + ".ramba-tmp"
+    os.makedirs(junk, exist_ok=True)
+    with open(os.path.join(junk, "partial"), "w") as f:
+        f.write("torn write")
+    checkpoint.save(p, {"w": rt.arange(100) * 3.0}, force=True)
+    back2 = checkpoint.restore(p)
+    np.testing.assert_allclose(np.asarray(back2["w"]), np.arange(100) * 3.0)
+    assert not os.path.exists(junk)
+
+
+def test_checkpoint_save_refuses_overwrite_without_force(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    from ramba_tpu import checkpoint
+
+    p = _ck(tmp_path, "ck_nof")
+    checkpoint.save(p, {"w": rt.arange(16) * 1.0})
+    with pytest.raises(ValueError, match="force=True"):
+        checkpoint.save(p, {"w": rt.arange(16) * 2.0})
+
+
+def test_checkpoint_io_retries_transient_fault(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    from ramba_tpu import checkpoint
+
+    p = _ck(tmp_path, "ck_retry")
+    before = registry.get("resilience.retries.checkpoint_io")
+    with faults.inject("checkpoint_io", "once"):
+        checkpoint.save(p, {"w": rt.arange(32) * 2.0})
+    assert registry.get("resilience.retries.checkpoint_io") == before + 1
+    back = checkpoint.restore(p)
+    np.testing.assert_allclose(np.asarray(back["w"]), np.arange(32) * 2.0)
+
+
+def test_checkpoint_restore_corrupt_raises_clear_error(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    from ramba_tpu import checkpoint
+
+    with pytest.raises(checkpoint.CheckpointCorruptError,
+                       match="no checkpoint directory"):
+        checkpoint.restore(_ck(tmp_path, "ck_missing"))
+    empty = tmp_path / "ck_empty"
+    empty.mkdir(exist_ok=True)
+    with pytest.raises(checkpoint.CheckpointCorruptError):
+        checkpoint.restore(str(empty))
+
+
+def test_checkpoint_restore_target_mismatch(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ramba_tpu import checkpoint
+    from ramba_tpu.parallel import mesh as _mesh
+
+    p = _ck(tmp_path, "ck_tgt")
+    w = rt.arange(64) * 1.0
+    checkpoint.save(p, {"w": w})
+    saved_dtype = np.asarray(w).dtype
+    sh = NamedSharding(_mesh.get_mesh(), P())
+    wrong_shape = jax.ShapeDtypeStruct((32,), saved_dtype, sharding=sh)
+    with pytest.raises(checkpoint.CheckpointCorruptError):
+        checkpoint.restore(p, {"w": wrong_shape})
+    ok = jax.ShapeDtypeStruct((64,), saved_dtype, sharding=sh)
+    with pytest.raises(checkpoint.CheckpointCorruptError):
+        checkpoint.restore(p, {"w": ok, "extra": ok})  # structure mismatch
+
+
+# -- fileio ------------------------------------------------------------------
+
+
+def test_fileio_read_retries_transient_fault(tmp_path):
+    from ramba_tpu import fileio
+
+    rank = os.environ.get("RAMBA_TEST_PROC_ID", "0")
+    p = tmp_path / f"fileio_retry_r{rank}.npy"
+    data = np.arange(4096, dtype=np.float32)
+    np.save(p, data)
+    before = registry.get("resilience.retries.fileio")
+    with faults.inject("fileio", "once"):
+        out = np.asarray(fileio.load(str(p)))
+    np.testing.assert_allclose(out, data)
+    assert registry.get("resilience.retries.fileio") >= before + 1
+
+
+# -- skeletons: once-per-kernel host-fallback warning ------------------------
+
+
+@pytest.mark.skipif(_MULTIPROC,
+                    reason="host fallback is single-controller only")
+def test_host_fallback_warns_once_per_kernel():
+    from ramba_tpu import skeletons
+
+    def countdown(x):
+        n = x
+        while n > 0:
+            n = n - 1.0
+        return n
+
+    def countup(x):
+        n = x
+        while n < 0:
+            n = n + 1.0
+        return n
+
+    skeletons.reset_fallback_warnings()
+    with pytest.warns(UserWarning, match="countdown.*host evaluation"):
+        np.asarray(rt.smap(countdown, [1.5, -1.0]))
+    assert countdown in skeletons.fallback_warned_kernels()
+    # same kernel again (different shape -> fresh trace): no second warning
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        np.asarray(rt.smap(countdown, [0.5, 2.0, 1.0]))
+    assert not [w for w in caught if "host evaluation" in str(w.message)]
+    # a DIFFERENT kernel falling back still warns (a global flag wouldn't)
+    with pytest.warns(UserWarning, match="countup.*host evaluation"):
+        np.asarray(rt.smap(countup, [-1.5, 1.0]))
+    # the reset hook re-arms the first kernel
+    skeletons.reset_fallback_warnings()
+    assert not skeletons.fallback_warned_kernels()
+    with pytest.warns(UserWarning, match="host evaluation"):
+        np.asarray(rt.smap(countdown, [2.5]))
+
+
+# -- distributed bring-up ----------------------------------------------------
+
+
+def test_init_timeout_env(monkeypatch):
+    from ramba_tpu.parallel import distributed
+
+    monkeypatch.delenv("RAMBA_INIT_TIMEOUT_S", raising=False)
+    assert distributed._init_kwargs({}) == {}
+    monkeypatch.setenv("RAMBA_INIT_TIMEOUT_S", "7")
+    assert distributed._init_kwargs({}) == {"initialization_timeout": 7}
+    assert distributed._init_kwargs({"initialization_timeout": 3}) == \
+        {"initialization_timeout": 3}  # explicit kwarg wins
+    monkeypatch.setenv("RAMBA_INIT_TIMEOUT_S", "bogus")
+    assert distributed._init_kwargs({}) == {}
+    monkeypatch.setenv("RAMBA_INIT_TIMEOUT_S", "0")
+    assert distributed._init_kwargs({}) == {}
+
+
+def _scrubbed_env():
+    env = dict(os.environ)
+    for k in ("RAMBA_TEST_PROCS", "RAMBA_TEST_PROC_ID", "RAMBA_TEST_COORD",
+              "RAMBA_TEST_SHARED_TMP", "RAMBA_PROFILE_DIR", "RAMBA_TRACE",
+              "RAMBA_FAULTS"):
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def test_init_connect_retries_then_chains_cause():
+    # Subprocess: initialize() early-returns in-process once the backend is
+    # up, so the connect path only exists pre-first-computation.  The
+    # injected fault fires BEFORE jax dials the (bogus) coordinator.
+    code = (
+        "from ramba_tpu.parallel import distributed\n"
+        "from ramba_tpu.resilience import faults, retry\n"
+        "from ramba_tpu import diagnostics\n"
+        "try:\n"
+        "    distributed.initialize(coordinator_address='127.0.0.1:1',\n"
+        "                           num_processes=2, process_id=0)\n"
+        "except retry.RetryBudgetExhausted as e:\n"
+        "    assert isinstance(e.__cause__, faults.InjectedFault), e.__cause__\n"
+        "    c = diagnostics.counters()\n"
+        "    assert c.get('resilience.retries.init_connect', 0) >= 1, c\n"
+        "    hs = [h for h in diagnostics.health_events(20)\n"
+        "          if h.get('source') == 'distributed_init']\n"
+        "    assert hs and hs[-1]['outcome'] == 'error', hs\n"
+        "    assert 'InjectedFault' in hs[-1].get('cause', ''), hs[-1]\n"
+        "    print('INIT_RETRY_OK')\n"
+        "else:\n"
+        "    raise SystemExit('initialize unexpectedly succeeded')\n"
+    )
+    env = _scrubbed_env()
+    env["RAMBA_FAULTS"] = "init_connect:always"
+    env["RAMBA_RETRY_ATTEMPTS"] = "2"
+    env["RAMBA_RETRY_BASE_S"] = "0"
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "INIT_RETRY_OK" in r.stdout
+
+
+# -- acceptance: env-driven fault + trace + report ---------------------------
+
+
+def test_compile_once_env_trace_and_report(tmp_path):
+    rank = os.environ.get("RAMBA_TEST_PROC_ID", "0")
+    path = tmp_path / f"trace_faults_{rank}.jsonl"
+    code = (
+        "import numpy as np\n"
+        "import ramba_tpu as rt\n"
+        "a = rt.arange(4096) * 2.0 + 1.0\n"
+        "s = float(rt.sum(a))\n"
+        "exp = float(np.sum(np.arange(4096) * 2.0 + 1.0))\n"
+        "assert abs(s - exp) <= 1e-6 * abs(exp), (s, exp)\n"
+        "from ramba_tpu import diagnostics\n"
+        "c = diagnostics.counters()\n"
+        "assert c.get('resilience.retries', 0) >= 1, c\n"
+        "print('RETRIES=%d' % c['resilience.retries'])\n"
+    )
+    env = _scrubbed_env()
+    env["RAMBA_FAULTS"] = "compile:once"
+    env["RAMBA_RETRY_BASE_S"] = "0.001"
+    env["RAMBA_TRACE"] = str(path)
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert int(r.stdout.strip().rsplit("RETRIES=", 1)[1]) >= 1
+
+    evs = [json.loads(ln) for ln in path.read_text().splitlines()
+           if ln.strip()]
+    assert any(e.get("type") == "fault" and e.get("site") == "compile"
+               for e in evs)
+    assert any(e.get("type") == "degrade" and e.get("action") == "retry"
+               and e.get("site") == "flush" for e in evs)
+
+    rep = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(__file__), "..", "scripts",
+                      "trace_report.py"), str(path)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert rep.returncode == 0, rep.stderr[-2000:]
+    assert "degradation timeline" in rep.stdout
+    assert "degradation totals:" in rep.stdout
+    assert "retry" in rep.stdout
