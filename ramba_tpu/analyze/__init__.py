@@ -1,0 +1,44 @@
+"""Static analysis over the deferred-op DAG (`ramba-lint` + RAMBA_VERIFY).
+
+Two entry points share one rule set (:mod:`ramba_tpu.analyze.rules`):
+
+* **Flush-time** — ``RAMBA_VERIFY=1`` verifies every program between
+  linearization and compilation (``fuser._verify_if_enabled``); error
+  findings raise :class:`ProgramVerificationError` in strict mode, or
+  route the flush down the degradation ladder otherwise.
+* **Offline** — ``python -m ramba_tpu.analyze trace.jsonl`` re-checks the
+  ``program`` events a ``RAMBA_TRACE`` capture recorded and summarizes
+  flush-time findings (:mod:`ramba_tpu.analyze.lint`).
+
+See docs/index.md "Static analysis & RAMBA_VERIFY" for the rule catalog.
+"""
+
+from __future__ import annotations
+
+from ramba_tpu.analyze.findings import (
+    SEVERITIES,
+    Finding,
+    ProgramVerificationError,
+)
+from ramba_tpu.analyze.rules import RULES
+from ramba_tpu.analyze.verifier import (
+    ProgramView,
+    analyze_exprs,
+    enabled_rules,
+    mode,
+    verify_flush,
+    verify_program,
+)
+
+__all__ = [
+    "SEVERITIES",
+    "Finding",
+    "ProgramVerificationError",
+    "ProgramView",
+    "RULES",
+    "analyze_exprs",
+    "enabled_rules",
+    "mode",
+    "verify_flush",
+    "verify_program",
+]

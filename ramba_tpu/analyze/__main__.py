@@ -1,0 +1,10 @@
+"""CLI shim: ``python -m ramba_tpu.analyze <trace.jsonl> ...``."""
+
+from __future__ import annotations
+
+import sys
+
+from ramba_tpu.analyze.lint import main
+
+if __name__ == "__main__":
+    sys.exit(main())

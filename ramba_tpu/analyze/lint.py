@@ -1,0 +1,204 @@
+"""``ramba-lint``: offline static analysis over RAMBA_TRACE JSONL captures.
+
+Usage (equivalently via ``scripts/ramba_lint.py``)::
+
+    python -m ramba_tpu.analyze /tmp/trace.jsonl [more.jsonl ...]
+    python -m ramba_tpu.analyze --json --strict trace.jsonl
+
+Consumes the trace a run wrote under ``RAMBA_TRACE=<path>`` (per-rank
+``.rank*`` siblings are auto-discovered).  Two sources of diagnostics:
+
+1. ``finding`` events the flush-time verifier already emitted (any
+   ``RAMBA_VERIFY`` mode) — summarized per rule and severity.
+2. ``program`` events every traced flush records — re-checked offline with
+   the rules that need only program structure (``graph-hygiene`` and
+   ``donation-hazard``, including the cross-regime cache-key collision
+   check: the same structural program captured under both x64 regimes in
+   one trace is flagged when keyed without the semantic fingerprint).
+
+Exit status: 0 on success, 1 under ``--strict`` when any error-severity
+finding exists, 2 when no trace file was found.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from collections import Counter
+from typing import Any, Dict, List, Optional, Sequence, TextIO, Tuple
+
+from ramba_tpu.analyze.findings import Finding
+
+#: Rules that can run from a recorded program event alone.
+OFFLINE_RULES: Tuple[str, ...] = ("donation-hazard", "graph-hygiene")
+
+
+class _RecordedProgram:
+    """Duck-typed stand-in for ``fuser._Program`` built from a ``program``
+    trace event — exactly the fields the offline-capable rules touch."""
+
+    __slots__ = ("instrs", "n_leaves", "leaf_kinds", "out_slots", "key")
+
+    def __init__(self, ev: Dict[str, Any]):
+        self.instrs = tuple(
+            (op, static, tuple(args)) for op, static, args in ev["instrs"]
+        )
+        self.n_leaves = int(ev["n_leaves"])
+        self.leaf_kinds = tuple(ev.get("leaf_kinds", ""))
+        self.out_slots = tuple(ev.get("out_slots", ()))
+        self.key = (self.instrs, self.n_leaves, self.leaf_kinds,
+                    self.out_slots)
+
+
+def discover(path: str) -> List[str]:
+    """The file itself, or its ``.rank*`` siblings (multi-controller)."""
+    files = []
+    if os.path.exists(path):
+        files.append(path)
+    files += sorted(glob.glob(glob.escape(path) + ".rank*"))
+    return files
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    events: List[Dict[str, Any]] = []
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                print(f"{path}:{ln}: unparseable line ({e})",
+                      file=sys.stderr)
+    return events
+
+
+def lint_events(
+    events: Sequence[Dict[str, Any]],
+) -> List[Tuple[str, Finding]]:
+    """Re-run the offline-capable rules over every recorded program.
+    Returns ``(program label, finding)`` pairs."""
+    from ramba_tpu.analyze import verifier as _verifier
+
+    # Structure-only keying plus the *recorded* regime as the fingerprint:
+    # flags traces (from code predating the fingerprinted cache key) where
+    # one structural key served two numeric regimes.
+    key_registry: Dict[Any, Any] = {}
+    out: List[Tuple[str, Finding]] = []
+    for ev in events:
+        if ev.get("type") != "program":
+            continue
+        label = str(ev.get("label", "?"))
+        try:
+            prog = _RecordedProgram(ev)
+        except Exception as e:
+            out.append((label, Finding(
+                "graph-hygiene", "warning", "program",
+                f"unreadable program event: {type(e).__name__}: {e}",
+            )))
+            continue
+        view = _verifier.ProgramView(
+            program=prog,
+            donate=tuple(ev.get("donate", ())),
+            owners=tuple(ev.get("owners", ())),
+            seg_size=0,  # segment replay needs live fuser sizing; skip
+            key_fn=lambda p, d: (p.key, d),
+            fingerprint=("x64", bool(ev.get("x64", False))),
+            key_registry=key_registry,
+        )
+        for f in _verifier.verify_program(view, OFFLINE_RULES):
+            out.append((label, f))
+    return out
+
+
+def render(
+    path: str,
+    events: Sequence[Dict[str, Any]],
+    file: Optional[TextIO] = None,
+) -> List[Tuple[str, Finding]]:
+    """Print the lint report for one trace; returns the offline findings."""
+    out = file or sys.stdout
+    programs = [e for e in events if e.get("type") == "program"]
+    flushes = [e for e in events if e.get("type") == "flush"]
+    recorded = [e for e in events if e.get("type") == "finding"]
+    print(f"== ramba-lint {path} ==", file=out)
+    print(
+        f"events: {len(events)}  flushes: {len(flushes)}  "
+        f"programs recorded: {len(programs)}  "
+        f"flush-time findings: {len(recorded)}",
+        file=out,
+    )
+
+    if recorded:
+        per = Counter(
+            (e.get("rule", "?"), e.get("severity", "?")) for e in recorded
+        )
+        print("flush-time findings by rule:", file=out)
+        for (rl, sev), n in sorted(per.items()):
+            print(f"  {rl:<20s} {sev:<8s} x{n}", file=out)
+
+    offline = lint_events(events)
+    if programs and not offline:
+        print(
+            f"offline re-check: {len(programs)} program(s) clean "
+            f"({', '.join(OFFLINE_RULES)})",
+            file=out,
+        )
+    for label, f in offline:
+        print(
+            f"  {f.severity.upper():<7s} [{f.rule}] {label} {f.node}: "
+            f"{f.message}",
+            file=out,
+        )
+    if not programs and not recorded:
+        print(
+            "no program/finding events in this trace — capture with "
+            "RAMBA_TRACE=<path> (and optionally RAMBA_VERIFY=1)",
+            file=out,
+        )
+    return offline
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ramba-lint",
+        description="Offline static analysis over RAMBA_TRACE JSONL "
+                    "captures.",
+    )
+    ap.add_argument("paths", nargs="+",
+                    help="trace file(s); .rank* siblings auto-discovered")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as JSON lines instead of text")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 if any error-severity finding exists")
+    args = ap.parse_args(argv)
+
+    files: List[str] = []
+    for p in args.paths:
+        found = discover(p)
+        if not found:
+            print(f"{p}: no trace file found", file=sys.stderr)
+            return 2
+        files += [f for f in found if f not in files]
+
+    any_error = False
+    for path in files:
+        events = load_events(path)
+        if args.json:
+            offline = lint_events(events)
+            for label, f in offline:
+                print(json.dumps({"trace": path, "label": label,
+                                  **f.as_event()}))
+        else:
+            offline = render(path, events)
+        recorded_errs = any(
+            e.get("type") == "finding" and e.get("severity") == "error"
+            for e in events
+        )
+        offline_errs = any(f.severity == "error" for _lbl, f in offline)
+        any_error = any_error or recorded_errs or offline_errs
+    return 1 if (args.strict and any_error) else 0

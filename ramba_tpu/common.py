@@ -98,6 +98,16 @@ def setup_persistent_cache() -> str | None:
     # The reference caches every generated kernel regardless of compile time.
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    # jax initializes the persistent cache lazily on the *first* compile and
+    # latches that state — if anything compiled before RAMBA_CACHE was
+    # applied (cache dir None at the time), the new dir is silently ignored.
+    # Force re-initialization so the dir takes effect mid-process.
+    try:
+        from jax.experimental.compilation_cache import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:
+        pass
     return path
 
 

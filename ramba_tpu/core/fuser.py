@@ -28,6 +28,7 @@ Differences, by design:
 from __future__ import annotations
 
 import hashlib
+import os
 import time
 import warnings
 import weakref
@@ -228,7 +229,9 @@ def _pending_roots() -> list:
 
 def _prepare_program(exprs: Sequence[Expr]):
     """Rewrite + linearize — shared by flush() and analyze_pending() so both
-    always see the identical program."""
+    always see the identical program.  Returns ``(program, leaves, exprs)``
+    where ``exprs`` are the (possibly rewritten) roots, so the RAMBA_VERIFY
+    verifier can re-check the very graph that was linearized."""
     if common.rewrite_enabled:
         from ramba_tpu.core.rewrite import rewrite_roots
 
@@ -243,7 +246,8 @@ def _prepare_program(exprs: Sequence[Expr]):
                 "from": "rewritten", "to": "unrewritten",
                 "error": f"{type(e).__name__}: {e}"[:300],
             })
-    return _linearize(exprs)
+    program, leaves = _linearize(exprs)
+    return program, leaves, exprs
 
 
 def _program_label(program: _Program) -> str:
@@ -254,13 +258,30 @@ def _program_label(program: _Program) -> str:
     return "prog_" + hashlib.sha256(text.encode()).hexdigest()[:12]
 
 
+def _semantic_fingerprint() -> tuple:
+    """Trace-time global configuration the OPS eval rules consult.  Anything
+    an eval rule reads while being traced MUST appear here: ``program.key``
+    captures structure only, so two programs with identical structure but
+    different trace-time semantics — e.g. NEP-50 promotion in
+    ``expr._np_loop_dtypes``, which keys off ``jax_enable_x64`` — would
+    otherwise share one compiled executable and silently reuse the wrong
+    numerics (the collision the analyze graph-hygiene rule detects)."""
+    return (bool(jax.config.jax_enable_x64),)
+
+
+def _cache_key(program: _Program, donate_key: tuple) -> tuple:
+    """Full compile-cache key: structure + donation mask + the trace-time
+    semantic fingerprint."""
+    return (program.key, donate_key, _semantic_fingerprint())
+
+
 def _get_compiled(program: _Program, donate_key: tuple):
     """Compile-cache lookup (mesh-epoch aware).  Returns (fn, is_new)."""
     global _cache_epoch
     if _cache_epoch != _mesh.mesh_epoch:
         _compile_cache.clear()
         _cache_epoch = _mesh.mesh_epoch
-    key = (program.key, donate_key)
+    key = _cache_key(program, donate_key)
     fn = _compile_cache.get(key)
     if fn is not None:
         _registry.inc("fuser.cache_hit")
@@ -498,15 +519,22 @@ def _run_host(program: _Program, leaf_vals, span: Optional[dict]):
 
 
 def _execute_resilient(program: _Program, leaf_vals, donate_key: tuple,
-                       span: Optional[dict]):
+                       span: Optional[dict], skip_fused: bool = False):
     """Run the program down the degradation ladder (see
     ``resilience.degrade``): fused → split → eager → host.  Returns
-    ``(outs, rung_name)``; rung_name is "fused" on the healthy path."""
-    rungs = [
-        ("fused",
-         lambda: _attempt_fused(program, leaf_vals, donate_key, span)),
-    ]
-    if len(program.instrs) > 1:
+    ``(outs, rung_name)``; rung_name is "fused" on the healthy path.
+
+    ``skip_fused`` (set when the RAMBA_VERIFY verifier found error
+    findings in non-strict mode) starts the ladder at the split rung:
+    no monolithic compile and no leaf donation, so a program the
+    verifier distrusts can still produce a result without consuming
+    caller-visible buffers."""
+    rungs = []
+    if not skip_fused:
+        rungs.append(
+            ("fused",
+             lambda: _attempt_fused(program, leaf_vals, donate_key, span)))
+    if len(program.instrs) > 1 or skip_fused:
         cap = common.max_program_instrs or len(program.instrs)
         half = max(1, min(len(program.instrs), cap) // 2)
         # no leaf donation below the fused rung: a donated buffer consumed
@@ -533,6 +561,69 @@ def _execute_resilient(program: _Program, leaf_vals, donate_key: tuple,
     return _degrade.run_ladder("flush", rungs, leaf_check=leaves_alive)
 
 
+def _leaf_owner_counts(leaves) -> list:
+    """Live-alias census per leaf slot: how many materialized ndarrays still
+    own each Const leaf's buffer (Scalar leaves own nothing)."""
+    return [
+        _const_owners.get(id(leaf.value), 0) if isinstance(leaf, Const) else 0
+        for leaf in leaves
+    ]
+
+
+def _program_event(program: _Program, leaves, donate_key: tuple,
+                   label: str) -> dict:
+    """Offline-lintable record of the program a flush is about to run —
+    ``python -m ramba_tpu.analyze`` re-checks graph hygiene and donation
+    hazards from these events without the live process.  Statics are
+    repr-truncated: the offline rules need structure (op names, slot refs,
+    donate mask, owner counts), not closure identities."""
+    return {
+        "type": "program", "label": label,
+        "instrs": [[op, repr(st)[:160], list(args)]
+                   for op, st, args in program.instrs],
+        "n_leaves": program.n_leaves,
+        "leaf_kinds": "".join(program.leaf_kinds),
+        "out_slots": list(program.out_slots),
+        "donate": list(donate_key),
+        "owners": _leaf_owner_counts(leaves),
+        "x64": bool(jax.config.jax_enable_x64),
+    }
+
+
+def _verify_if_enabled(program: _Program, leaves, exprs, donate_key: tuple,
+                       span: dict, label: str) -> bool:
+    """RAMBA_VERIFY hook: statically verify the program about to execute
+    (see ramba_tpu.analyze).  Strict mode raises ProgramVerificationError
+    on error findings — before ``_get_compiled`` is ever reached, so a
+    malformed program never compiles, let alone runs.  Non-strict mode
+    returns True instead, routing the flush down the degradation ladder
+    (skip the fused rung: no monolithic compile, no leaf donation).
+    Zero-cost when RAMBA_VERIFY is unset."""
+    if not os.environ.get("RAMBA_VERIFY"):
+        return False
+    from ramba_tpu.analyze import verifier as _verifier
+
+    vmode = _verifier.mode()
+    if vmode == "off":
+        return False
+    findings = _verifier.verify_flush(program, leaves, exprs, donate_key,
+                                      label=label)
+    if findings:
+        counts: dict = {}
+        for f in findings:
+            counts[f.severity] = counts.get(f.severity, 0) + 1
+        span["findings"] = counts
+    errors = [f for f in findings if f.severity == "error"]
+    if not errors:
+        return False
+    if vmode == "strict":
+        from ramba_tpu.analyze.findings import ProgramVerificationError
+
+        raise ProgramVerificationError(errors)
+    span["verify_routed"] = True
+    return True
+
+
 def flush(extra: Sequence[Expr] = ()) -> list:
     """Materialize every pending ndarray (and ``extra`` expressions) in one
     fused jit call (or, above ``common.max_program_instrs`` instructions, a
@@ -550,7 +641,7 @@ def flush(extra: Sequence[Expr] = ()) -> list:
         from ramba_tpu.core.rewrite import stats as _rw_stats
 
         rw_before = dict(_rw_stats)
-    program, leaves = _prepare_program(exprs)
+    program, leaves, vexprs = _prepare_program(exprs)
     linearize_s = time.perf_counter() - t_flush
     rewrite_fires = {}
     if rw_before is not None:
@@ -589,15 +680,30 @@ def flush(extra: Sequence[Expr] = ()) -> list:
         else:
             leaf_vals.append(leaf.value)
     donate_key = tuple(donate)
-    span["donated"] = len(donate)
+    try:
+        _faults.check("donate_census", donated=len(donate_key))
+    except _faults.InjectedFault:
+        # Deliberately corrupt the donate mask (ignore the alias census) —
+        # the seeded violation the RAMBA_VERIFY donation-hazard rule exists
+        # to catch.  Only reachable under explicit fault injection.
+        donate_key = tuple(
+            i for i, leaf in enumerate(leaves) if isinstance(leaf, Const)
+        )
+    span["donated"] = len(donate_key)
     span["leaf_bytes"] = leaf_bytes
+    if _events.trace_enabled():
+        _events.emit(_program_event(program, leaves, donate_key, label))
     _profile.ensure_started()
     try:
+        skip_fused = _verify_if_enabled(
+            program, leaves, vexprs, donate_key, span, label
+        )
         with _profile.annotation("ramba_flush:" + label):
             with warnings.catch_warnings():
                 warnings.filterwarnings("ignore", message=".*[Dd]onat.*")
                 outs, rung = _execute_resilient(program, leaf_vals,
-                                                donate_key, span)
+                                                donate_key, span,
+                                                skip_fused=skip_fused)
     except Exception as e:
         # Quarantine: every rung of the ladder failed (or the error was
         # fatal).  The roots of THIS program must leave the pending
@@ -654,7 +760,7 @@ def analyze_pending() -> Optional[dict]:
     exprs = [a._expr for a in roots]
     if not exprs:
         return None
-    program, leaves = _prepare_program(exprs)
+    program, leaves, _vexprs = _prepare_program(exprs)
     avals = []
     for leaf in leaves:
         v = leaf.value

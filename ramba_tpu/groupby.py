@@ -15,73 +15,64 @@ from stacked slices is expressed directly.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ramba_tpu.core.expr import Node, defop
-from ramba_tpu.utils import compat as _compat
 from ramba_tpu.core.ndarray import ndarray, as_exprable
 from ramba_tpu.ops.creation import asarray
 
 
+def _reduce_identity(op, dtype):
+    """Identity element of a segment reduction, matching jax.ops.segment_*
+    semantics for empty segments (sum->0, prod->1, min->dtype max, ...)."""
+    dt = jnp.dtype(dtype)
+    if op == "sum":
+        return jnp.zeros((), dt)
+    if op == "prod":
+        return jnp.ones((), dt)
+    if dt == jnp.bool_:
+        return jnp.asarray(op == "min", dt)
+    if jnp.issubdtype(dt, jnp.inexact):
+        return jnp.asarray(jnp.inf if op == "min" else -jnp.inf, dt)
+    info = jnp.iinfo(dt)
+    return jnp.asarray(info.max if op == "min" else info.min, dt)
+
+
 def _dist_segment_multi(pairs, labels, num_groups, mesh):
-    """Distributed segment reductions: per-shard LOCAL scatters under ONE
-    shard_map traversal, then an explicit cross-shard combine of the
-    (num_groups, rest) partials — the reference's per-worker partials +
-    tree reduce (ramba.py:2296-2331) in XLA-collective form.
+    """Distributed segment reductions, scatter-free.
 
-    ``pairs`` is a list of (op, data); all scatters share the single pass
-    so mean/var read the operand from HBM once, not 2-3 times.
+    ``pairs`` is a list of (op, data) sharing one label array; all
+    reductions share the same one-hot group mask so mean/var read the
+    label comparison once.
 
-    r3 context: GSPMD miscompiles scatter-adds whose segment axis is
-    sharded (wrong partial sums; reconfirmed r4 through the groupby test
-    suite even with single-axis sharding).  The r3 workaround replicated
-    the whole operand (advisor r4: OOM risk).  Here every scatter runs on
-    a LOCAL unsharded block — the miscompiling pattern never reaches
-    GSPMD — and the operand stays fully distributed."""
-    from jax.sharding import PartitionSpec as _P
-
-    axes = tuple(mesh.axis_names)
-    k = int(np.prod([mesh.shape[a] for a in axes]))
-    if k == 1:
-        return [
-            getattr(jax.ops, f"segment_{op}")(d, labels, num_segments=num_groups)
-            for op, d in pairs
-        ]
+    r3-r5 context: GSPMD miscompiles scatter-based segment reductions
+    whenever the operand carries a non-trivial layout (r3: segment axis
+    sharded; r5: operand derived from a transposed slice of a 2-D-sharded
+    array gives silently wrong sums, with or without shard_map).  Every
+    workaround that kept the scatter (shard_map over local blocks,
+    sharding constraints, optimization barriers) still miscompiled on
+    some input layout, so the scatter is gone entirely: each group's
+    reduction is a masked dense reduce over the segment axis —
+    ``reduce(where(labels==g, data, identity), axis=0)`` for all groups at
+    once via a broadcast compare.  Dense reduces partition correctly
+    under GSPMD on every layout tested.  The (num_groups, n, rest)
+    intermediate is never materialized — XLA fuses the broadcast compare
+    and select into the reduction loop — so memory stays O(n*rest +
+    num_groups*rest); compute is O(num_groups*n*rest), fine for the
+    modest group counts groupby sees (calendar months, category codes).
+    """
+    del mesh  # layout-independent; kept for signature stability
     n = pairs[0][1].shape[0]
-    pad = (-n) % k
-    ds = [d for _, d in pairs]
-    if pad:
-        ds = [
-            jnp.concatenate([d, jnp.zeros((pad,) + d.shape[1:], d.dtype)], 0)
-            for d in ds
-        ]
-        # padded rows land in a throwaway segment (num_groups)
-        labels = jnp.concatenate(
-            [labels, jnp.full((pad,), num_groups, labels.dtype)], 0
-        )
-
-    def local(lb, *blocks):
-        return tuple(
-            getattr(jax.ops, f"segment_{op}")(
-                b, lb, num_segments=num_groups + 1
-            )[None]
-            for (op, _), b in zip(pairs, blocks)
-        )
-
-    partials = _compat.shard_map(
-        local, mesh=mesh,
-        in_specs=(_P(axes),) * (1 + len(ds)),
-        out_specs=(_P(axes),) * len(ds),
-        check_vma=False,
-    )(labels, *ds)  # each: (k, num_groups+1, rest...)
-    comb = {"sum": jnp.sum, "prod": jnp.prod,
-            "min": jnp.min, "max": jnp.max}
-    return [
-        comb[op](p, axis=0)[:num_groups]
-        for (op, _), p in zip(pairs, partials)
-    ]
+    gid = jnp.arange(num_groups, dtype=labels.dtype)
+    grp_mask = labels[None, :] == gid[:, None]  # (num_groups, n) one-hot
+    comb = {"sum": jnp.sum, "prod": jnp.prod, "min": jnp.min, "max": jnp.max}
+    outs = []
+    for op, d in pairs:
+        mask = grp_mask.reshape((num_groups, n) + (1,) * (d.ndim - 1))
+        contrib = jnp.where(mask, d[None], _reduce_identity(op, d.dtype))
+        outs.append(comb[op](contrib, axis=1))
+    return outs
 
 
 @defop("segment_reduce")

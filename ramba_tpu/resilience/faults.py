@@ -19,7 +19,9 @@ Grammar: a comma-separated list of ``site:mode`` specs.  Modes:
 
 Sites are free-form strings; the ones wired into the codebase are
 ``compile``, ``execute``, ``oom``, ``eager``, ``host``, ``rewrite``,
-``checkpoint_io``, ``fileio``, ``init_connect``.  The ``oom`` site (or a
+``checkpoint_io``, ``fileio``, ``init_connect``, and ``donate_census``
+(which does not fail the flush: it corrupts the buffer-donation mask so
+the RAMBA_VERIFY donation-hazard rule has a real violation to catch).  The ``oom`` site (or a
 trailing ``:oom`` kind) raises :class:`InjectedResourceExhausted`, whose
 message carries the ``RESOURCE_EXHAUSTED`` marker the retry classifier
 keys on; a trailing ``:fatal`` kind raises a non-retryable fault.

@@ -1,0 +1,23 @@
+#!/usr/bin/env python
+"""ramba-lint: offline static analysis over RAMBA_TRACE JSONL captures.
+
+Thin wrapper so the linter runs from a checkout without installation::
+
+    python scripts/ramba_lint.py /tmp/trace.jsonl [--strict] [--json]
+
+Equivalent to ``python -m ramba_tpu.analyze``; see that module's help.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from ramba_tpu.analyze.lint import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,5 +8,7 @@ echo "=== leg 1: x64 (NumPy-exact) ==="
 python -m pytest tests/ -q "$@"
 echo "=== leg 2: x32 (TPU numerics) ==="
 RAMBA_TEST_X64=0 python -m pytest tests/ -q "$@"
-echo "=== leg 3: 2-process fault injection (RAMBA_FAULTS=compile:once) ==="
+echo "=== leg 3: RAMBA_VERIFY=1 (strict flush-time program verifier) ==="
+RAMBA_VERIFY=1 python -m pytest tests/ -q "$@"
+echo "=== leg 4: 2-process fault injection (RAMBA_FAULTS=compile:once) ==="
 python scripts/two_process_suite.py --fault-leg

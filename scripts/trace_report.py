@@ -75,6 +75,7 @@ def report(path: str, events: list, top: int = 10, file=None) -> None:
             print(f"  error: {h['error']}", file=file)
 
     _degradation_timeline(events, file=file)
+    _findings_summary(events, file=file)
 
     flushes = [e for e in events if e.get("type") == "flush"]
     if not flushes:
@@ -130,6 +131,29 @@ def report(path: str, events: list, top: int = 10, file=None) -> None:
             f"  {label:<18s} {w:10.4f}s  x{cnt:<5d} compile {comp:.4f}s",
             file=file,
         )
+
+
+def _findings_summary(events: list, file=None) -> None:
+    """Static-analysis findings (RAMBA_VERIFY / ramba-lint) by rule and
+    severity, with a sample message per bucket."""
+    file = file or sys.stdout
+    findings = [e for e in events if e.get("type") == "finding"]
+    if not findings:
+        return
+    per = defaultdict(lambda: [0, ""])  # (rule, severity) -> [count, sample]
+    for e in findings:
+        ent = per[(e.get("rule", "?"), e.get("severity", "?"))]
+        ent[0] += 1
+        if not ent[1]:
+            ent[1] = str(e.get("message", ""))[:60]
+    print(f"verifier findings ({len(findings)}):", file=file)
+    print(f"  {'rule':<20s} {'severity':<9s} {'count':>5s}  sample",
+          file=file)
+    sev_rank = {"error": 0, "warning": 1, "info": 2}
+    for (rule, sev), (n, sample) in sorted(
+        per.items(), key=lambda kv: (sev_rank.get(kv[0][1], 3), kv[0][0])
+    ):
+        print(f"  {rule:<20s} {sev:<9s} {n:>5d}  {sample}", file=file)
 
 
 def _degradation_timeline(events: list, file=None, cap: int = 50) -> None:

@@ -1,0 +1,473 @@
+"""Static analysis: the flush-time verifier (``RAMBA_VERIFY``) and the
+``ramba_tpu.analyze`` rule set.
+
+One seeded-violation fixture per rule, each asserting the exact
+``Finding`` the rule must emit:
+
+* ``donation-hazard``    — the ``donate_census`` fault site corrupts the
+  donate mask exactly as a census bug would, and the verifier must catch
+  it before XLA consumes an aliased buffer (strict: raise; warn: route
+  down the ladder and still produce the right answer).  The segmented
+  replay leg simulates a broken ``_last_use_map``.
+* ``shape-dtype``        — a Node whose recorded aval disagrees with
+  re-inference (the signature of a rewrite-rule bug).
+* ``sharding-legality``  — a hint naming a mesh axis that does not
+  exist, a non-associative distributed scan, a stencil halo wider than
+  one shard.
+* ``graph-hygiene``      — forward slot references, dangling outputs,
+  dead subgraphs, and the compile-cache key collision detector (run
+  against a deliberately fingerprint-less keying function — the exact
+  deficiency ``fuser._cache_key`` fixed).
+
+Plus the offline lint path (``python -m ramba_tpu.analyze``) over a
+synthetic trace, and negative controls: valid flushes under strict mode
+must produce zero error findings (the fuzz leg in test_fuzz.py widens
+this).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+import ramba_tpu as rt
+from ramba_tpu import analyze, common
+from ramba_tpu.analyze import lint as alint
+from ramba_tpu.analyze import rules as arules
+from ramba_tpu.analyze import verifier as averifier
+from ramba_tpu.analyze.findings import Finding, ProgramVerificationError
+from ramba_tpu.core import fuser
+from ramba_tpu.core.expr import Node, as_expr
+from ramba_tpu.observe import events
+from ramba_tpu.parallel import mesh as pmesh
+from ramba_tpu.resilience import faults
+
+
+@pytest.fixture(autouse=True)
+def _isolate(monkeypatch):
+    """Start each test with an empty pending set and no fault plan; keep
+    the suite's outer RAMBA_VERIFY (the strict CI leg) from leaking into
+    tests that exercise a specific mode by letting them monkeypatch it."""
+    fuser.flush()
+    faults.configure(None)
+    yield
+    faults.reset()
+
+
+def _findings(fs, rule, severity=None):
+    return [f for f in fs if f.rule == rule
+            and (severity is None or f.severity == severity)]
+
+
+# ---------------------------------------------------------------------------
+# donation-hazard
+# ---------------------------------------------------------------------------
+
+
+class TestDonationHazard:
+    def test_strict_raises_before_execution(self, monkeypatch):
+        monkeypatch.setenv("RAMBA_VERIFY", "1")
+        a = rt.asarray(np.ones((512, 512)))  # live owner of its buffer
+        b = a + 1.0
+        with faults.inject("donate_census", "once"):
+            with pytest.raises(ProgramVerificationError) as ei:
+                fuser.flush()
+        errs = _findings(ei.value.findings, "donation-hazard", "error")
+        assert errs, ei.value.findings
+        assert errs[0].node.startswith("leaf")
+        assert "alias" in errs[0].message
+        # Nothing executed, nothing donated: both arrays still usable.
+        monkeypatch.setenv("RAMBA_VERIFY", "0")
+        np.testing.assert_array_equal(np.asarray(a), 1.0)
+        np.testing.assert_array_equal(np.asarray(b), 2.0)
+
+    def test_warn_mode_routes_down_ladder(self, monkeypatch):
+        monkeypatch.setenv("RAMBA_VERIFY", "warn")
+        a = rt.asarray(np.ones((256, 256)))
+        b = a * 3.0
+        with faults.inject("donate_census", "once"):
+            fuser.flush()
+        span = events.last(1, type="flush")[-1]
+        assert span.get("verify_routed") is True
+        assert span.get("degraded") == "split"  # fused rung skipped
+        assert span["findings"]["error"] >= 1
+        ev = events.last(5, type="finding")
+        assert any(e["rule"] == "donation-hazard" for e in ev)
+        # The degraded path donates nothing, so the answer and the aliased
+        # input both survive.
+        np.testing.assert_array_equal(np.asarray(b), 3.0)
+        np.testing.assert_array_equal(np.asarray(a), 1.0)
+
+    def test_clean_flush_has_no_findings(self, monkeypatch):
+        monkeypatch.setenv("RAMBA_VERIFY", "1")
+        a = rt.asarray(np.ones((256, 256)))
+        b = a + a
+        fuser.flush()  # must not raise
+        np.testing.assert_array_equal(np.asarray(b), 2.0)
+
+    def test_scalar_and_out_of_range_slots(self):
+        prog = fuser._Program((("negative", None, (0,)),), 2, ("C", "S"), (2,))
+        view = averifier.ProgramView(program=prog, donate=(1, 7))
+        fs = arules.RULES["donation-hazard"](view)
+        assert Finding(
+            "donation-hazard", "error", "leaf1",
+            "donated leaf is a python scalar, not a device buffer",
+        ) in fs
+        assert any(f.node == "leaf7" and "only 2 leaves" in f.message
+                   for f in fs)
+
+    def test_donated_program_output(self):
+        prog = fuser._Program((("negative", None, (0,)),), 1, ("C",), (0, 1))
+        view = averifier.ProgramView(program=prog, donate=(0,))
+        fs = arules.RULES["donation-hazard"](view)
+        assert Finding(
+            "donation-hazard", "error", "leaf0",
+            "donated leaf is also a program output; XLA would return "
+            "a deleted buffer",
+        ) in fs
+
+    def test_segmented_replay_catches_bad_liveness(self, monkeypatch):
+        # slot0's true last use is instr2 (slot 3); a liveness bug that
+        # thinks it dies in segment 0 would donate it mid-chain and hand
+        # segment 1 a deleted buffer.  The rule replays fuser's segment
+        # donation decisions and must flag the read-after-donate.
+        instrs = (
+            ("negative", None, (0,)),
+            ("negative", None, (1,)),
+            ("add", None, (0, 2)),
+            ("negative", None, (3,)),
+        )
+        prog = fuser._Program(instrs, 1, ("C",), (4,))
+        bad = dict(fuser._last_use_map(prog))
+        bad[0] = 1
+        monkeypatch.setattr(fuser, "_last_use_map", lambda p: bad)
+        view = averifier.ProgramView(program=prog, donate=(0,), seg_size=2)
+        fs = arules.RULES["donation-hazard"](view)
+        seg = [f for f in fs if f.node == "slot0" and "segment" in f.message]
+        assert seg and seg[0].severity == "error"
+        assert "already donated by segment 0" in seg[0].message
+
+    def test_segmented_real_flush_clean_under_strict(self, monkeypatch):
+        # End-to-end negative control: a long chain over a donatable
+        # (unowned, >=1MB) leaf runs segmented under strict verification
+        # without a single finding — fuser's actual liveness is sound.
+        monkeypatch.setenv("RAMBA_VERIFY", "1")
+        monkeypatch.setattr(common, "max_program_instrs", 3)
+        a = rt.asarray(np.ones((512, 512)))
+        b = a
+        for _ in range(8):
+            b = b + 1.0
+        del a  # owner count drops to 0: the leaf becomes donate-eligible
+        np.testing.assert_array_equal(np.asarray(b), 9.0)
+
+
+# ---------------------------------------------------------------------------
+# shape-dtype
+# ---------------------------------------------------------------------------
+
+
+class TestShapeDtype:
+    def test_corrupt_recorded_aval(self):
+        a = rt.asarray(np.ones((3, 3), np.float32))
+        b = a + a
+        node = b._expr
+        assert isinstance(node, Node)
+        bad = Node(node.op, node.static, node.args,
+                   aval=jax.ShapeDtypeStruct((5, 5), np.dtype(np.int32)))
+        view = averifier.ProgramView(exprs=[bad])
+        fs = arules.RULES["shape-dtype"](view)
+        anchor = f"node0:{node.op}"
+        want_shape = tuple(node.aval.shape)
+        want_dtype = node.aval.dtype
+        assert Finding(
+            "shape-dtype", "error", anchor,
+            f"recorded shape (5, 5) != re-inferred {want_shape}",
+        ) in fs
+        assert Finding(
+            "shape-dtype", "error", anchor,
+            f"recorded dtype int32 != re-inferred {want_dtype}",
+        ) in fs
+        fuser.flush()  # drain b
+
+    def test_faithful_graph_is_clean(self):
+        a = rt.asarray(np.arange(12.0).reshape(3, 4))
+        b = (a * 2.0).T + 1.0
+        fs = analyze.analyze_exprs([b._expr], rule_names=["shape-dtype"])
+        assert fs == []
+        fuser.flush()
+
+
+# ---------------------------------------------------------------------------
+# sharding-legality
+# ---------------------------------------------------------------------------
+
+
+def _multidevice_mesh():
+    m = pmesh.get_mesh()
+    if int(m.devices.size) <= 1:
+        pytest.skip("sharding-legality distribution checks need >1 device")
+    return m
+
+
+class TestShardingLegality:
+    def test_hint_names_unknown_mesh_axis(self):
+        x = as_expr(np.ones((8, 8), np.float32))
+        hint = Node("shard_hint", (("bogus_axis",),), [x], aval=x.aval)
+        fs = analyze.analyze_exprs([hint], rule_names=["sharding-legality"])
+        errs = _findings(fs, "sharding-legality", "error")
+        assert errs and "'bogus_axis'" in errs[0].message
+        assert errs[0].node.endswith(":shard_hint")
+
+    def test_nonassociative_distributed_scan_warns(self):
+        _multidevice_mesh()
+        x = as_expr(np.ones((4096,), np.float32))
+        node = Node("scumulative", (None, None, False, 0, True), [x],
+                    aval=x.aval)
+        fs = arules.RULES["sharding-legality"](
+            averifier.ProgramView(exprs=[node]))
+        assert [(f.severity, f.node) for f in fs] == [
+            ("warning", "node0:scumulative")]
+        assert "non-associative" in fs[0].message
+
+    def test_associative_distributed_scan_is_clean(self):
+        x = as_expr(np.ones((4096,), np.float32))
+        node = Node("scumulative", (None, None, True, 0, True), [x],
+                    aval=x.aval)
+        fs = arules.RULES["sharding-legality"](
+            averifier.ProgramView(exprs=[node]))
+        assert fs == []
+
+    def test_stencil_halo_wider_than_shard(self):
+        mesh = _multidevice_mesh()
+        n = 4096
+        x = as_expr(np.ones((n,), np.float32))
+        # halo > ceil(n / total devices) on every possible axis assignment
+        halo = n // 2 + 1
+        node = Node("stencil", (None, (-halo,), (halo,), (0,), ()), [x],
+                    aval=x.aval)
+        fs = arules.RULES["sharding-legality"](
+            averifier.ProgramView(exprs=[node]))
+        warns = _findings(fs, "sharding-legality", "warning")
+        assert warns, (fs, mesh.shape)
+        assert "halo" in warns[0].message and "shard width" in warns[0].message
+
+    def test_small_stencil_halo_is_clean(self):
+        x = as_expr(np.ones((4096,), np.float32))
+        node = Node("stencil", (None, (-1,), (1,), (0,), ()), [x],
+                    aval=x.aval)
+        fs = arules.RULES["sharding-legality"](
+            averifier.ProgramView(exprs=[node]))
+        assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# graph-hygiene
+# ---------------------------------------------------------------------------
+
+
+class TestGraphHygiene:
+    def test_forward_reference_is_a_cycle(self):
+        prog = fuser._Program((("add", None, (0, 2)),), 1, ("C",), (1,))
+        view = averifier.ProgramView(program=prog, key_registry={})
+        fs = arules.RULES["graph-hygiene"](view)
+        errs = _findings(fs, "graph-hygiene", "error")
+        assert errs and errs[0].node == "instr0:add"
+        assert "forward/self reference" in errs[0].message
+
+    def test_dangling_output_slot(self):
+        prog = fuser._Program((), 1, ("C",), (5,))
+        view = averifier.ProgramView(program=prog, key_registry={})
+        fs = arules.RULES["graph-hygiene"](view)
+        assert any(f.severity == "error" and f.node == "slot5"
+                   and "dangles" in f.message for f in fs)
+
+    def test_dead_subgraph_warns(self):
+        prog = fuser._Program(
+            (("negative", None, (0,)), ("exp", None, (0,))),
+            1, ("C",), (2,),
+        )
+        view = averifier.ProgramView(program=prog, key_registry={})
+        fs = arules.RULES["graph-hygiene"](view)
+        warns = _findings(fs, "graph-hygiene", "warning")
+        assert warns and warns[0].node == "instr0"
+        assert "dead subgraph" in warns[0].message
+        assert "negative" in warns[0].message
+
+    def test_real_program_is_clean(self):
+        a = rt.asarray(np.ones((4, 4)))
+        b = (a + 1.0) * a
+        prog, _leaves, _ = fuser._prepare_program([b._expr])
+        view = averifier.ProgramView(program=prog, key_registry={})
+        assert arules.RULES["graph-hygiene"](view) == []
+        fuser.flush()
+
+
+# ---------------------------------------------------------------------------
+# compile-cache key: the collision detector, and the fingerprint fix the
+# detector motivated
+# ---------------------------------------------------------------------------
+
+
+class TestCacheKey:
+    def _program(self):
+        a = rt.asarray(np.ones((4, 4), np.float32))
+        b = a * 2.0
+        prog, _leaves, _ = fuser._prepare_program([b._expr])
+        fuser.flush()
+        return prog
+
+    def test_detector_flags_fingerprintless_keying(self):
+        # Key programs the pre-fix way (structure only).  The same key
+        # observed under two semantic regimes is exactly the stale-cache
+        # bug the fingerprint field now prevents.
+        prog = self._program()
+        reg = {}
+        deficient = lambda p, d: (p.key, d)
+        assert arules.check_cache_key(
+            prog, (), key_fn=deficient, fingerprint=("x64", False),
+            registry=reg) == []
+        fs = arules.check_cache_key(
+            prog, (), key_fn=deficient, fingerprint=("x64", True),
+            registry=reg)
+        assert len(fs) == 1
+        assert fs[0].rule == "graph-hygiene" and fs[0].severity == "error"
+        assert "collision" in fs[0].message
+        assert "('x64', False)" in fs[0].message
+
+    def test_live_key_carries_the_fingerprint(self):
+        # Regression for the fix itself: toggling jax_enable_x64 must
+        # change fuser._cache_key even for a structurally identical
+        # program (NEP-50 promotion in expr reads x64 at trace time).
+        prog = self._program()
+        old = bool(jax.config.jax_enable_x64)
+        k1 = fuser._cache_key(prog, ())
+        try:
+            jax.config.update("jax_enable_x64", not old)
+            k2 = fuser._cache_key(prog, ())
+        finally:
+            jax.config.update("jax_enable_x64", old)
+        assert k1[0] == k2[0]  # same structure...
+        assert k1 != k2        # ...distinct executables
+
+    def test_unhashable_key_warns(self):
+        prog = self._program()
+        fs = arules.check_cache_key(
+            prog, (), key_fn=lambda p, d: [p.key], registry={})
+        assert len(fs) == 1 and fs[0].severity == "warning"
+        assert "unhashable" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# verifier plumbing: modes, rule selection, event emission
+# ---------------------------------------------------------------------------
+
+
+class TestVerifierPlumbing:
+    def test_mode_parsing(self, monkeypatch):
+        for v, want in [("", "off"), ("0", "off"), ("off", "off"),
+                        ("1", "strict"), ("strict", "strict"),
+                        ("errors", "strict"), ("warn", "warn"),
+                        ("yes-please", "warn")]:
+            monkeypatch.setenv("RAMBA_VERIFY", v)
+            assert averifier.mode() == want, v
+        monkeypatch.delenv("RAMBA_VERIFY")
+        assert averifier.mode() == "off"
+
+    def test_rule_selection(self, monkeypatch):
+        monkeypatch.delenv("RAMBA_VERIFY_RULES", raising=False)
+        monkeypatch.delenv("RAMBA_VERIFY_SKIP", raising=False)
+        assert set(averifier.enabled_rules()) == set(arules.RULES)
+        monkeypatch.setenv("RAMBA_VERIFY_RULES", "graph-hygiene,shape-dtype")
+        assert averifier.enabled_rules() == ["shape-dtype", "graph-hygiene"]
+        monkeypatch.setenv("RAMBA_VERIFY_SKIP", "shape-dtype")
+        assert averifier.enabled_rules() == ["graph-hygiene"]
+
+    def test_skip_disables_a_rule(self, monkeypatch):
+        monkeypatch.setenv("RAMBA_VERIFY", "1")
+        monkeypatch.setenv("RAMBA_VERIFY_SKIP", "donation-hazard")
+        a = rt.asarray(np.ones((512, 512)))
+        b = a + 1.0
+        with faults.inject("donate_census", "once"):
+            fuser.flush()  # hazard seeded, rule disabled: no raise
+        np.testing.assert_array_equal(np.asarray(b), 2.0)
+        del a
+
+    def test_finding_validates_severity(self):
+        with pytest.raises(ValueError):
+            Finding("r", "catastrophic", "n", "m")
+
+    def test_as_event_shape(self):
+        f = Finding("shape-dtype", "error", "node0:add", "boom")
+        assert f.as_event("lbl") == {
+            "type": "finding", "rule": "shape-dtype", "severity": "error",
+            "node": "node0:add", "message": "boom", "label": "lbl",
+        }
+
+
+# ---------------------------------------------------------------------------
+# offline lint (python -m ramba_tpu.analyze)
+# ---------------------------------------------------------------------------
+
+
+def _program_event(**over):
+    ev = {"type": "program", "label": "prog_test",
+          "instrs": [["negative", "None", [0]]], "n_leaves": 1,
+          "leaf_kinds": "C", "out_slots": [1], "donate": [],
+          "owners": [1], "x64": False}
+    ev.update(over)
+    return ev
+
+
+class TestOfflineLint:
+    def test_recheck_flags_recorded_hazard(self, tmp_path, capsys):
+        p = tmp_path / "t.jsonl"
+        p.write_text(json.dumps(_program_event(donate=[0], owners=[2])) + "\n")
+        rc = alint.main([str(p)])
+        out = capsys.readouterr().out
+        assert rc == 0  # errors reported, but not --strict
+        assert "[donation-hazard]" in out and "prog_test" in out
+        assert alint.main(["--strict", str(p)]) == 1
+
+    def test_cross_regime_key_collision(self, tmp_path):
+        p = tmp_path / "t.jsonl"
+        p.write_text(json.dumps(_program_event(x64=False)) + "\n"
+                     + json.dumps(_program_event(x64=True)) + "\n")
+        pairs = alint.lint_events(alint.load_events(str(p)))
+        assert any(f.severity == "error" and "collision" in f.message
+                   for _lbl, f in pairs)
+
+    def test_clean_trace(self, tmp_path, capsys):
+        p = tmp_path / "t.jsonl"
+        p.write_text(json.dumps(_program_event()) + "\n")
+        assert alint.main(["--strict", str(p)]) == 0
+        assert "clean" in capsys.readouterr().out
+
+    def test_json_output(self, tmp_path, capsys):
+        p = tmp_path / "t.jsonl"
+        p.write_text(json.dumps(_program_event(donate=[0], owners=[3])) + "\n")
+        assert alint.main(["--json", str(p)]) == 0
+        lines = [json.loads(ln) for ln in
+                 capsys.readouterr().out.strip().splitlines()]
+        assert lines and lines[0]["rule"] == "donation-hazard"
+        assert lines[0]["type"] == "finding"
+
+    def test_missing_trace_exits_2(self, tmp_path, capsys):
+        assert alint.main([str(tmp_path / "absent.jsonl")]) == 2
+
+    def test_live_trace_roundtrip(self, tmp_path, monkeypatch):
+        # A real traced flush produces program events the offline linter
+        # re-checks clean.
+        path = str(tmp_path / "live.jsonl")
+        events.configure(path)
+        try:
+            a = rt.asarray(np.ones((64, 64)))
+            b = a + 1.0
+            fuser.flush()
+            np.asarray(b)
+        finally:
+            events.configure(None)
+        evs = alint.load_events(alint.discover(path)[0])
+        assert any(e.get("type") == "program" for e in evs)
+        assert alint.lint_events(evs) == []

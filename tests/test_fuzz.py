@@ -160,6 +160,19 @@ def test_random_program_wide(block):
 
 
 # ---------------------------------------------------------------------------
+# Verifier leg: every valid generated program must flush clean under
+# RAMBA_VERIFY strict mode — an error finding on a well-formed graph is a
+# false positive, and strict mode turns it into ProgramVerificationError.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(0, 40, 4))
+def test_random_program_verified_strict(seed, monkeypatch):
+    monkeypatch.setenv("RAMBA_VERIFY", "strict")
+    _check(seed)
+
+
+# ---------------------------------------------------------------------------
 # Mutation + manipulation fuzz: setitem, masked writes, fancy indexing,
 # concatenate/stack/pad/roll/sort/take — the reference's other test axis
 # (test_distributed_array.py drives slicing/assignment heavily).

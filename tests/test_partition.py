@@ -113,9 +113,12 @@ class TestTwoDMeshRegressions:
         hlo = jax.jit(step).lower(x).compile().as_text()
         import re
 
+        # Count only instructions that *are* collective-permutes ("= f32[..]
+        # collective-permute(") — fusions/concats merely naming a permute as
+        # an operand on the same line must not be counted as halo traffic.
         halo_elems = 0
         for m in re.finditer(
-            r"f32\[(\d+),(\d+)\][^\n]*collective-permute", hlo
+            r"= f32\[(\d+),(\d+)\][^=\n]*collective-permute\(", hlo
         ):
             halo_elems += int(m.group(1)) * int(m.group(2))
         # 2-D (4,2) split of 256x256 with radius 1: per-shard halos are
