@@ -478,6 +478,16 @@ def main():
     except Exception:  # noqa: BLE001 - even import/backend failure emits JSON
         out["error"] = traceback.format_exc(limit=3)[-400:]
 
+    # High-water mark of device-resident ledger bytes across the whole
+    # run — how much HBM the bench actually held live at once, from the
+    # memory governor's ledger (ramba_tpu/resilience/memory.py).
+    try:
+        from ramba_tpu.resilience import memory as _memory
+
+        out["memory.peak_live_bytes"] = _memory.ledger.peak_live_bytes
+    except Exception:  # noqa: BLE001 - never let bookkeeping break the JSON
+        pass
+
     # Persist/recall the last successful on-TPU run: the tunneled chip can
     # be unreachable for hours (round-4 postmortem: a killed client wedged
     # the relay lease), so a CPU-fallback OR total-failure line also

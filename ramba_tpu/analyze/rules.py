@@ -159,6 +159,86 @@ def check_donation(view: "ProgramView") -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# analytic memory footprint (used by resilience.memory admission control)
+# ---------------------------------------------------------------------------
+
+
+def _aval_nbytes(aval: Any) -> int:
+    try:
+        import numpy as _np
+
+        return int(math.prod(aval.shape)) * _np.dtype(aval.dtype).itemsize
+    except Exception:
+        return 0
+
+
+def slot_nbytes(program: Any, leaf_avals: Sequence[Any]) -> Dict[int, int]:
+    """Estimated byte size of every value slot (leaves + instruction
+    outputs) of a linearized program, from the same memoized abstract
+    eval (``expr.infer_aval``) the shape-dtype rule re-infers with.
+    Slots whose abstract eval needs live context map to 0 (unknown)."""
+    from ramba_tpu.core.expr import infer_aval
+
+    avals: Dict[int, Any] = {}
+    sizes: Dict[int, int] = {}
+    for i, a in enumerate(leaf_avals):
+        avals[i] = a
+        sizes[i] = _aval_nbytes(a)
+    n = program.n_leaves
+    for k, (op, static, args) in enumerate(program.instrs):
+        slot = n + k
+        arg_avals = [avals.get(s) for s in args]
+        if any(a is None for a in arg_avals):
+            avals[slot] = None
+            sizes[slot] = 0
+            continue
+        try:
+            av = infer_aval(op, static, arg_avals)
+        except Exception:
+            avals[slot] = None
+            sizes[slot] = 0
+            continue
+        avals[slot] = av
+        sizes[slot] = _aval_nbytes(av)
+    return sizes
+
+
+def estimate_peak_bytes(program: Any, leaf_avals: Sequence[Any],
+                        donate: Sequence[int] = ()) -> int:
+    """Analytic peak-live-bytes estimate: simulate the program's live set
+    instruction by instruction.  Non-donated leaves stay resident to the
+    end (the caller holds them); donated leaves and intermediates free
+    after their last use; program outputs never free.  Mirrors the
+    lifetime rules ``fuser._run_segmented`` executes with, so it is the
+    deterministic fallback when XLA's ``memory_analysis`` reports
+    nothing (CPU backends)."""
+    from ramba_tpu.core import fuser as _fuser
+
+    sizes = slot_nbytes(program, leaf_avals)
+    last_use = _fuser._last_use_map(program)
+    donate_set = set(donate)
+    n = program.n_leaves
+    end = n + len(program.instrs)
+    drops: Dict[int, List[int]] = {}
+    for s, lu in last_use.items():
+        if lu >= end:
+            continue  # program output (pinned) — never freed
+        if s < n and s not in donate_set:
+            continue  # caller-visible leaf: resident for the whole run
+        drops.setdefault(lu, []).append(s)
+    live = sum(sizes.get(i, 0) for i in range(n))
+    peak = live
+    for k in range(len(program.instrs)):
+        slot = n + k
+        live += sizes.get(slot, 0)
+        if live > peak:
+            peak = live
+        for s in drops.get(slot, ()):
+            live -= sizes.get(s, 0)
+    return peak
+
+
+# ---------------------------------------------------------------------------
 # shape/dtype re-inference
 # ---------------------------------------------------------------------------
 

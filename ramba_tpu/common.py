@@ -36,6 +36,31 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
+_BYTE_SUFFIXES = {"k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40}
+
+
+def parse_bytes(s) -> int:
+    """Parse a byte count: a plain integer or an integer/float with a
+    ``k``/``m``/``g``/``t`` suffix (binary multiples, case-insensitive,
+    optional trailing ``b``/``ib``): ``"512k"`` → 524288, ``"1.5g"`` →
+    1610612736.  Shared by the memory governor (``RAMBA_HBM_BUDGET``) and
+    the fault harness (``oom:...:bytes=1g``).  Raises ValueError on junk."""
+    if isinstance(s, (int, float)):
+        return int(s)
+    text = str(s).strip().lower()
+    if not text:
+        raise ValueError("empty byte count")
+    for tail in ("ib", "b"):
+        if text.endswith(tail) and text[:-len(tail)][-1:] in _BYTE_SUFFIXES:
+            text = text[:-len(tail)]
+            break
+    mult = 1
+    if text[-1:] in _BYTE_SUFFIXES:
+        mult = _BYTE_SUFFIXES[text[-1]]
+        text = text[:-1]
+    return int(float(text) * mult)
+
+
 # --- debug / timing flags (reference: common.py:102-178) ---------------------
 debug_level = _env_int("RAMBA_DEBUG", 0)
 timing_level = _env_int("RAMBA_TIMING", 0)

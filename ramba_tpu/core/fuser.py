@@ -44,6 +44,8 @@ from ramba_tpu.observe import registry as _registry
 from ramba_tpu.parallel import mesh as _mesh
 from ramba_tpu.resilience import degrade as _degrade
 from ramba_tpu.resilience import faults as _faults
+from ramba_tpu.resilience import memory as _memory
+from ramba_tpu.resilience.spill import SpilledArray as _SpilledArray
 from ramba_tpu.utils import timing as _timing
 
 # Donation is pointless for small buffers and fragments the jit cache (the
@@ -110,8 +112,13 @@ def _pending_arrays() -> list:
     return out
 
 
-def owner_incref(buf) -> None:
+def owner_incref(buf, const=None) -> None:
+    """Count one more live ndarray owning ``buf``.  When the owning
+    ``Const`` node is supplied (ndarray._set_expr does), the buffer is
+    also registered with the memory governor's live-bytes ledger."""
     _const_owners[id(buf)] = _const_owners.get(id(buf), 0) + 1
+    if const is not None:
+        _memory.on_incref(const)
 
 
 def owner_decref(buf) -> None:
@@ -119,8 +126,28 @@ def owner_decref(buf) -> None:
     n = _const_owners.get(k, 0) - 1
     if n <= 0:
         _const_owners.pop(k, None)
+        _memory.on_release(buf)
     else:
         _const_owners[k] = n
+
+
+def owner_rekey(old, new) -> None:
+    """Migrate the owner census when the memory governor swaps a Const's
+    value object (device array ↔ host spill wrapper): the count follows
+    the buffer identity, so the donation decision at the next flush sees
+    the same aliasing it would have seen without the spill."""
+    n = _const_owners.pop(id(old), 0)
+    if n > 0:
+        _const_owners[id(new)] = _const_owners.get(id(new), 0) + n
+
+
+def leaf_value(leaf):
+    """Device value of a Const leaf, transparently restoring it from a
+    host spill if the memory governor evicted it (resilience.memory)."""
+    v = leaf.value
+    if isinstance(v, _SpilledArray):
+        return _memory.restore(leaf)
+    return v
 
 
 def note_node_created() -> None:
@@ -310,10 +337,44 @@ def _last_use_map(program: _Program) -> dict:
     return last_use
 
 
+def _byte_segment_end(instrs, n_leaves, start: int, slot_bytes: dict,
+                      max_seg_bytes: int, seg_cap: int) -> int:
+    """First instruction index past a byte-bounded segment starting at
+    ``start``: accumulate the estimated bytes each instruction adds to
+    the segment's live set (its output slot plus any external inputs it
+    pulls in) and stop before the running total crosses
+    ``max_seg_bytes``.  Always admits at least one instruction."""
+    base = n_leaves + start
+    ninstr = len(instrs)
+    seen_in: set = set()
+    seg_bytes = 0
+    end = start
+    while end < ninstr:
+        if seg_cap and end - start >= seg_cap:
+            break
+        _op, _st, args = instrs[end]
+        cost = slot_bytes.get(n_leaves + end, 0)
+        for s in args:
+            if s < base and s not in seen_in:
+                cost += slot_bytes.get(s, 0)
+        if end > start and seg_bytes + cost > max_seg_bytes:
+            break
+        for s in args:
+            if s < base:
+                seen_in.add(s)
+        seg_bytes += cost
+        end += 1
+    return end
+
+
 def _iter_segments(program: _Program, last_use: dict,
-                   seg_size: Optional[int] = None):
+                   seg_size: Optional[int] = None, *,
+                   slot_bytes: Optional[dict] = None,
+                   max_seg_bytes: Optional[int] = None):
     """Split ``program`` into sub-programs of at most ``seg_size``
-    (default ``common.max_program_instrs``) instructions.  Yields
+    (default ``common.max_program_instrs``) instructions — or, when
+    ``max_seg_bytes``/``slot_bytes`` are given (the ``chunked`` rung), of
+    bounded *estimated live bytes* per segment.  Yields
     ``(seg_prog, in_slots, out_here, top)`` where ``in_slots`` are the
     parent-program value slots the segment consumes, ``out_here`` the
     parent slots it must emit (used later or program outputs), and ``top``
@@ -324,7 +385,11 @@ def _iter_segments(program: _Program, last_use: dict,
     ninstr = len(instrs)
     start = 0
     while start < ninstr:
-        end = min(start + seg_size, ninstr)
+        if max_seg_bytes and slot_bytes is not None:
+            end = _byte_segment_end(instrs, n_leaves, start, slot_bytes,
+                                    max_seg_bytes, seg_size)
+        else:
+            end = min(start + seg_size, ninstr)
         base, top = n_leaves + start, n_leaves + end
         seg = instrs[start:end]
         in_slots = sorted(
@@ -351,7 +416,9 @@ def _iter_segments(program: _Program, last_use: dict,
 
 def _run_segmented(program: _Program, leaf_vals: list, donate_idx: tuple,
                    span: Optional[dict] = None,
-                   seg_size: Optional[int] = None):
+                   seg_size: Optional[int] = None, *,
+                   slot_bytes: Optional[dict] = None,
+                   max_seg_bytes: Optional[int] = None):
     """Execute an oversized program as chained jit calls of at most
     ``seg_size`` (default ``common.max_program_instrs``) instructions each.
 
@@ -369,7 +436,8 @@ def _run_segmented(program: _Program, leaf_vals: list, donate_idx: tuple,
     donate_set = set(donate_idx)
     vals: dict[int, object] = dict(enumerate(leaf_vals))
     for seg_prog, in_slots, out_here, top in _iter_segments(
-        program, last_use, seg_size
+        program, last_use, seg_size,
+        slot_bytes=slot_bytes, max_seg_bytes=max_seg_bytes,
     ):
         seg_donate = []
         for j, s in enumerate(in_slots):
@@ -391,6 +459,26 @@ def _run_segmented(program: _Program, leaf_vals: list, donate_idx: tuple,
         stats["segments"] += 1
         _registry.inc("fuser.segments")
     return tuple(vals[s] for s in program.out_slots)
+
+
+def _run_chunked(program: _Program, leaf_vals, donate_idx: tuple,
+                 span: Optional[dict] = None):
+    """The ``chunked`` rung: the segmented executor bounded by *estimated
+    live bytes* per segment (resilience.memory supplies the target)
+    instead of instruction count.  Donation-chain semantics are exactly
+    ``_run_segmented``'s — mid-chain intermediates (and cleared leaves,
+    when admission control routed here with a donate mask) still free as
+    they die, which is what bounds the peak live set."""
+    from ramba_tpu.analyze import rules as _rules
+
+    avals = _memory._leaf_avals(leaf_vals)
+    slot_bytes = _rules.slot_nbytes(program, avals)
+    cap = _memory.chunk_target_bytes()
+    if span is not None:
+        span["chunk_bytes"] = cap
+    _registry.inc("fuser.chunked_runs")
+    return _run_segmented(program, leaf_vals, donate_idx, span=span,
+                          slot_bytes=slot_bytes, max_seg_bytes=cap)
 
 
 def _execute_compiled(fn, program: _Program, leaf_vals, is_new: bool,
@@ -519,22 +607,31 @@ def _run_host(program: _Program, leaf_vals, span: Optional[dict]):
 
 
 def _execute_resilient(program: _Program, leaf_vals, donate_key: tuple,
-                       span: Optional[dict], skip_fused: bool = False):
+                       span: Optional[dict], skip_fused: bool = False,
+                       route_chunked: bool = False):
     """Run the program down the degradation ladder (see
-    ``resilience.degrade``): fused → split → eager → host.  Returns
-    ``(outs, rung_name)``; rung_name is "fused" on the healthy path.
+    ``resilience.degrade``): fused → split → chunked → eager → host.
+    Returns ``(outs, rung_name)``; rung_name is "fused" on the healthy
+    path.
 
     ``skip_fused`` (set when the RAMBA_VERIFY verifier found error
     findings in non-strict mode) starts the ladder at the split rung:
     no monolithic compile and no leaf donation, so a program the
     verifier distrusts can still produce a result without consuming
-    caller-visible buffers."""
+    caller-visible buffers.
+
+    ``route_chunked`` (set by memory-governor admission control when the
+    program cannot fit under the HBM watermark even after eviction)
+    starts the ladder at the chunked rung — and, uniquely among
+    below-fused rungs, KEEPS the donate mask: no failed attempt has
+    consumed anything yet, and donating dead leaves is exactly what
+    bounds the chunked peak."""
     rungs = []
-    if not skip_fused:
+    if not skip_fused and not route_chunked:
         rungs.append(
             ("fused",
              lambda: _attempt_fused(program, leaf_vals, donate_key, span)))
-    if len(program.instrs) > 1 or skip_fused:
+    if (len(program.instrs) > 1 or skip_fused) and not route_chunked:
         cap = common.max_program_instrs or len(program.instrs)
         half = max(1, min(len(program.instrs), cap) // 2)
         # no leaf donation below the fused rung: a donated buffer consumed
@@ -543,6 +640,11 @@ def _execute_resilient(program: _Program, leaf_vals, donate_key: tuple,
             ("split",
              lambda: _run_segmented(program, leaf_vals, (), span=span,
                                     seg_size=half)))
+    if len(program.instrs) > 1 or route_chunked:
+        chunk_donate = donate_key if route_chunked else ()
+        rungs.append(
+            ("chunked",
+             lambda: _run_chunked(program, leaf_vals, chunk_donate, span)))
     rungs.append(("eager", lambda: _run_eager(program, leaf_vals, span)))
     try:
         single = jax.process_count() == 1
@@ -670,6 +772,10 @@ def flush(extra: Sequence[Expr] = ()) -> list:
     for i, leaf in enumerate(leaves):
         if isinstance(leaf, Const):
             v = leaf.value
+            if isinstance(v, _SpilledArray):
+                # Evicted by the memory governor; bring it home before the
+                # donation decision so the census sees the device buffer.
+                v = _memory.restore(leaf)
             leaf_vals.append(v)
             leaf_bytes += _nbytes(v)
             if (
@@ -691,19 +797,26 @@ def flush(extra: Sequence[Expr] = ()) -> list:
         )
     span["donated"] = len(donate_key)
     span["leaf_bytes"] = leaf_bytes
+    span["mem_live_bytes"] = _memory.ledger.live_bytes
     if _events.trace_enabled():
         _events.emit(_program_event(program, leaves, donate_key, label))
     _profile.ensure_started()
+    # In-flight leaves are never spill candidates: admission-triggered (or
+    # oom-triggered) eviction during THIS flush must not pull a buffer the
+    # program is about to read.
+    _mem_pins = _memory.ledger.pin_values(leaf_vals)
     try:
         skip_fused = _verify_if_enabled(
             program, leaves, vexprs, donate_key, span, label
         )
+        route_chunked = _memory.admit(program, leaf_vals, donate_key, span)
         with _profile.annotation("ramba_flush:" + label):
             with warnings.catch_warnings():
                 warnings.filterwarnings("ignore", message=".*[Dd]onat.*")
                 outs, rung = _execute_resilient(program, leaf_vals,
                                                 donate_key, span,
-                                                skip_fused=skip_fused)
+                                                skip_fused=skip_fused,
+                                                route_chunked=route_chunked)
     except Exception as e:
         # Quarantine: every rung of the ladder failed (or the error was
         # fatal).  The roots of THIS program must leave the pending
@@ -722,6 +835,8 @@ def flush(extra: Sequence[Expr] = ()) -> list:
             "error": f"{type(e).__name__}: {e}"[:300],
         })
         raise
+    finally:
+        _memory.ledger.unpin(_mem_pins)
     if rung != "fused":
         span["degraded"] = rung
     stats["flushes"] += 1
@@ -764,7 +879,9 @@ def analyze_pending() -> Optional[dict]:
     avals = []
     for leaf in leaves:
         v = leaf.value
-        if isinstance(v, jax.Array):
+        if isinstance(v, (jax.Array, _SpilledArray)):
+            # a spilled leaf carries its device sharding; analysis must
+            # not force a restore (analyze_pending never executes)
             avals.append(
                 jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=v.sharding)
             )
@@ -833,12 +950,14 @@ def sync() -> None:
     waiters = _pending_arrays()
     flush()
     jax.block_until_ready(
-        [a._expr.value for a in waiters if isinstance(a._expr, Const)]
+        [a._expr.value for a in waiters
+         if isinstance(a._expr, Const)
+         and isinstance(a._expr.value, jax.Array)]  # spilled: nothing in flight
     )
 
 
 def evaluate(expr: Expr):
     """Evaluate one expression (flushing all pending work alongside it)."""
     if isinstance(expr, Const):
-        return expr.value
+        return leaf_value(expr)
     return flush(extra=[expr])[0]

@@ -224,7 +224,7 @@ class ndarray:
             fuser.owner_decref(old.value)
         self._expr = new
         if isinstance(new, Const):
-            fuser.owner_incref(new.value)
+            fuser.owner_incref(new.value, new)
             fuser.unregister_pending(self)
         else:
             fuser.register_pending(self)
@@ -317,7 +317,9 @@ class ndarray:
                 # innocent co-pending array materializes fine; a genuinely
                 # broken one re-raises its real error here.
                 self._set_expr(Const(fuser.flush(extra=[self._expr])[0]))
-            return self._expr.value
+            # leaf_value restores the buffer if the memory governor
+            # spilled it to host while this array was cold
+            return fuser.leaf_value(self._expr)
         return fuser.flush(extra=[self.read_expr()])[0]
 
     def asarray(self) -> np.ndarray:

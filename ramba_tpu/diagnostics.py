@@ -46,10 +46,22 @@ def resilience_events(n: int = 20) -> list:
     return _events.last(n, type=("fault", "degrade"))
 
 
+def memory_report(top: int = 5) -> dict:
+    """Ledger snapshot from the memory governor: budget/watermark, live /
+    spilled / pinned bytes, peak live bytes, eviction and restore counts,
+    and the top-``top`` resident arrays by size — "what is eating my
+    HBM" without reading trace JSONL.  All byte fields are 0/None on a
+    budgetless backend until arrays materialize."""
+    from ramba_tpu.resilience import memory as _memory
+
+    return _memory.ledger.snapshot(top=top)
+
+
 def snapshot() -> dict:
     """Everything, JSON-serializable: registry stores + the event ring."""
     snap = _registry.snapshot()
     snap["events"] = list(_events.ring)
+    snap["memory"] = memory_report()
     return snap
 
 
@@ -80,6 +92,26 @@ def report(file=None) -> None:
                     ("site", "action", "attempt", "from", "to", "rung",
                      "mode", "error") if ev.get(k) is not None]
             print(f"  {ev.get('type', '?'):<8s}" + " ".join(bits), file=file)
+    mem = memory_report()
+    if mem["arrays"] or mem["evictions"] or mem["spilled_bytes"]:
+        print("-- memory --", file=file)
+        print(
+            f"  live={mem['live_bytes']:,d}B"
+            f" spilled={mem['spilled_bytes']:,d}B"
+            f" pinned={mem['pinned_bytes']:,d}B"
+            f" peak={mem['peak_live_bytes']:,d}B"
+            f" evictions={mem['evictions']} restores={mem['restores']}"
+            f" arrays={mem['arrays']}",
+            file=file,
+        )
+        for row in mem["top"]:
+            state = "spilled" if row["spilled"] else (
+                "pinned" if row["pinned"] else "resident")
+            print(
+                f"    {row['nbytes']:>12,d}B {str(tuple(row['shape'])):<16s}"
+                f" {row['dtype']:<10s} {state}",
+                file=file,
+            )
     fl = last_flushes()
     if fl:
         print(f"-- last {len(fl)} flush span(s) --", file=file)

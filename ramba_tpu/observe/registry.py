@@ -43,6 +43,13 @@ def inc(name: str, n: int = 1) -> None:
     counters[name] += n
 
 
+def gauge(name: str, value) -> None:
+    """Set a counter to an absolute level (e.g. ``memory.live_bytes``) —
+    same store and naming convention as :func:`inc`, but last-write-wins
+    semantics for quantities that go down as well as up."""
+    counters[name] = int(value)
+
+
 def get(name: str) -> int:
     return counters.get(name, 0)
 

@@ -14,16 +14,25 @@ turns those signals into recovery instead of a crash:
   kernel compile+execute, Orbax checkpoint I/O, fileio reads/writes, and
   ``jax.distributed.initialize``.
 * ``degrade`` — the graceful-degradation ladder for kernel execution:
-  fused → split (smaller jit segments) → eager (per-op, no jit) → host
-  (CPU backend), each step emitted as a ``degrade`` event and counter so
-  ``scripts/trace_report.py`` can show a degradation timeline.
+  fused → split (smaller jit segments) → chunked (byte-bounded segments)
+  → eager (per-op, no jit) → host (CPU backend), each step emitted as a
+  ``degrade`` event and counter so ``scripts/trace_report.py`` can show
+  a degradation timeline.
+* ``memory``  — the memory-pressure governor: per-device HBM budget
+  (``RAMBA_HBM_BUDGET``), a live-bytes ledger over every realized leaf,
+  pre-flush admission control (evict or route to the chunked rung before
+  XLA can OOM), and LRU host spill with transparent restore-on-touch.
+* ``spill``   — the host-spill primitives the governor uses
+  (``SpilledArray`` wrapper + device_get/device_put round-trip).
 
 Everything here is transparent when nothing fails: with ``RAMBA_FAULTS``
 unset and no real errors, zero ``resilience.*`` counters fire and the
-flush hot path pays one closure call and one try/except.
+flush hot path pays one closure call and one try/except; with no HBM
+budget known (the CPU-test default) the governor never estimates,
+spills, or transfers anything.
 """
 
-from ramba_tpu.resilience import degrade, faults, retry  # noqa: F401
+from ramba_tpu.resilience import degrade, faults, memory, retry, spill  # noqa: F401
 from ramba_tpu.resilience.faults import (  # noqa: F401
     InjectedFault, InjectedResourceExhausted,
 )

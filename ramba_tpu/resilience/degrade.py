@@ -5,16 +5,25 @@ OOM where re-attempting the identical program is pointless — execution
 walks down a ladder of progressively cheaper-to-satisfy strategies
 instead of crashing the program:
 
-    fused  →  split  →  eager  →  host
+    fused  →  split  →  chunked  →  eager  →  host
 
 * **fused**: the normal path — one jit-compiled program (possibly
   auto-segmented by ``RAMBA_TPU_MAX_PROGRAM_INSTRS``).
 * **split**: the same program re-run through the segmented executor with
   a halved segment size and no leaf donation — smaller XLA programs,
   smaller peak live set.
+* **chunked**: the segmented executor bounded by estimated live *bytes*
+  per segment (``fuser._run_chunked`` / ``resilience.memory``) — the
+  memory-pressure rung.  Admission control can also start the ladder
+  here directly, before anything has failed.
 * **eager**: per-op dispatch with no jit at all.
 * **host**: the whole program interpreted on the CPU backend (device →
   host fallback as a first-class path; only offered single-controller).
+
+``oom``-class failures (real or injected ``RESOURCE_EXHAUSTED``) get an
+extra recovery step before the ladder moves: the memory governor evicts
+spill candidates (``memory.evict_for_oom``), so the next rung starts
+with more free HBM — "evict → drop one rung → retry", not blind backoff.
 
 Each rung transition is emitted as a ``degrade`` event and counter so
 ``scripts/trace_report.py`` can show the degradation timeline; each rung
@@ -35,7 +44,7 @@ from ramba_tpu.observe import registry as _registry
 from ramba_tpu.resilience import retry as _retry
 
 #: Canonical rung order for the flush ladder.
-LADDER = ("fused", "split", "eager", "host")
+LADDER = ("fused", "split", "chunked", "eager", "host")
 
 
 def run_ladder(site: str, rungs: List[Tuple[str, Callable]], *,
@@ -61,12 +70,23 @@ def run_ladder(site: str, rungs: List[Tuple[str, Callable]], *,
         try:
             out = _retry.call(site, thunk)
         except Exception as e:
-            if _retry.classify(e) == "fatal":
+            cls = _retry.classify(e)
+            if cls == "fatal":
                 raise
             if leaf_check is not None and not leaf_check():
                 # Donated inputs are gone; a lower rung would recompute
                 # from deleted buffers.  Surface the real failure.
                 raise
+            if cls == "oom":
+                # Device memory exhaustion: free HBM before the next rung
+                # runs — eviction is the recovery, the rung drop is the
+                # insurance.
+                try:
+                    from ramba_tpu.resilience import memory as _memory
+
+                    _memory.evict_for_oom(e)
+                except Exception:
+                    pass
             last = e
             prev_name = name
             continue

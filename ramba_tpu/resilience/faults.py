@@ -4,9 +4,10 @@ Recovery code that only runs when a TPU is preempted is recovery code
 that has never run.  This module lets every resilience path in the repo
 be driven on a laptop, deterministically, from one env var::
 
-    RAMBA_FAULTS="compile:0.5,checkpoint_io:once,oom:after=3"
+    RAMBA_FAULTS="compile:0.5,checkpoint_io:once,oom:after=3:bytes=1g"
 
-Grammar: a comma-separated list of ``site:mode`` specs.  Modes:
+Grammar: a comma-separated list of ``site:mode[:kind][:bytes=N]``
+specs.  Modes:
 
 * ``once``      fire on the first check of that site, then disarm
 * ``always``    fire on every check
@@ -24,7 +25,12 @@ Sites are free-form strings; the ones wired into the codebase are
 the RAMBA_VERIFY donation-hazard rule has a real violation to catch).  The ``oom`` site (or a
 trailing ``:oom`` kind) raises :class:`InjectedResourceExhausted`, whose
 message carries the ``RESOURCE_EXHAUSTED`` marker the retry classifier
-keys on; a trailing ``:fatal`` kind raises a non-retryable fault.
+keys on; a trailing ``:fatal`` kind raises a non-retryable fault.  An
+``oom`` spec may carry a byte-count payload (``bytes=<n>``, with the
+``common.parse_bytes`` k/m/g grammar): the exception's ``.bytes``
+attribute and the emitted fault event record how much allocation
+pressure was simulated, so memory-governor tests can assert *how much*
+the eviction path was asked to free, not just that something blew up.
 
 ``check(site)`` is a near-no-op (one dict lookup on an empty dict) when
 no faults are configured, so call sites can stay unconditional.
@@ -39,6 +45,7 @@ import warnings
 from contextlib import contextmanager
 from typing import Dict, Optional
 
+from ramba_tpu import common as _common
 from ramba_tpu.observe import events as _events
 from ramba_tpu.observe import registry as _registry
 
@@ -58,13 +65,20 @@ class InjectedFault(RuntimeError):
 
 
 class InjectedResourceExhausted(InjectedFault):
-    """Simulated device OOM; classified as degrade-worthy, not retryable
-    in place (retrying the identical allocation would just OOM again)."""
+    """Simulated device OOM; classified as the ``oom`` class, not
+    retryable in place (retrying the identical allocation would just OOM
+    again).  ``bytes`` carries the simulated allocation size when the
+    spec supplied one (``oom:after=3:bytes=1g``), mirroring real XLA
+    RESOURCE_EXHAUSTED messages that name the failed allocation."""
 
     retryable = False
 
-    def __init__(self, site: str, call: int):
-        super().__init__(site, call, "RESOURCE_EXHAUSTED: simulated out of memory")
+    def __init__(self, site: str, call: int, nbytes: Optional[int] = None):
+        self.bytes = nbytes
+        detail = "RESOURCE_EXHAUSTED: simulated out of memory"
+        if nbytes:
+            detail += f" allocating {int(nbytes)} bytes"
+        super().__init__(site, call, detail)
 
 
 class InjectedFatalFault(InjectedFault):
@@ -74,15 +88,17 @@ class InjectedFatalFault(InjectedFault):
 
 
 class _Spec:
-    __slots__ = ("site", "mode", "kind", "n", "p", "calls", "fired")
+    __slots__ = ("site", "mode", "kind", "n", "p", "nbytes", "calls", "fired")
 
     def __init__(self, site: str, mode: str, kind: str,
-                 n: Optional[int] = None, p: Optional[float] = None):
+                 n: Optional[int] = None, p: Optional[float] = None,
+                 nbytes: Optional[int] = None):
         self.site = site
         self.mode = mode      # "once" | "always" | "count" | "after" | "prob"
         self.kind = kind      # "transient" | "oom" | "fatal"
         self.n = n
         self.p = p
+        self.nbytes = nbytes  # simulated allocation size for oom kinds
         self.calls = 0
         self.fired = 0
 
@@ -98,19 +114,35 @@ def _parse_one(chunk: str) -> _Spec:
         raise ValueError(f"bad RAMBA_FAULTS spec {chunk!r}: want site:mode")
     site = parts[0].strip()
     mode = parts[1].strip()
-    kind = parts[2].strip().lower() if len(parts) > 2 else ""
-    if len(parts) > 3:
-        raise ValueError(f"bad RAMBA_FAULTS spec {chunk!r}: too many fields")
+    kind = ""
+    nbytes: Optional[int] = None
+    for extra in parts[2:]:
+        extra = extra.strip().lower()
+        if extra.startswith("bytes="):
+            if nbytes is not None:
+                raise ValueError(
+                    f"bad RAMBA_FAULTS spec {chunk!r}: duplicate bytes=")
+            try:
+                nbytes = _common.parse_bytes(extra[len("bytes="):])
+            except ValueError:
+                raise ValueError(
+                    f"bad RAMBA_FAULTS byte count in {chunk!r}") from None
+        elif not kind:
+            kind = extra
+        else:
+            raise ValueError(
+                f"bad RAMBA_FAULTS spec {chunk!r}: too many fields")
     if kind not in ("", "oom", "fatal", "transient"):
         raise ValueError(f"bad RAMBA_FAULTS kind {kind!r} in {chunk!r}")
     if not kind:
         kind = "oom" if site == "oom" else "transient"
     if mode == "once":
-        return _Spec(site, "once", kind)
+        return _Spec(site, "once", kind, nbytes=nbytes)
     if mode == "always":
-        return _Spec(site, "always", kind)
+        return _Spec(site, "always", kind, nbytes=nbytes)
     if mode.startswith("after="):
-        return _Spec(site, "after", kind, n=int(mode[len("after="):]))
+        return _Spec(site, "after", kind, n=int(mode[len("after="):]),
+                     nbytes=nbytes)
     try:
         n = int(mode)
     except ValueError:
@@ -118,14 +150,14 @@ def _parse_one(chunk: str) -> _Spec:
     else:
         if n < 0:
             raise ValueError(f"bad RAMBA_FAULTS count in {chunk!r}")
-        return _Spec(site, "count", kind, n=n)
+        return _Spec(site, "count", kind, n=n, nbytes=nbytes)
     try:
         p = float(mode)
     except ValueError:
         raise ValueError(f"bad RAMBA_FAULTS mode {mode!r} in {chunk!r}") from None
     if not 0.0 <= p <= 1.0:
         raise ValueError(f"RAMBA_FAULTS probability out of [0,1] in {chunk!r}")
-    return _Spec(site, "prob", kind, p=p)
+    return _Spec(site, "prob", kind, p=p, nbytes=nbytes)
 
 
 def _parse(spec: Optional[str], strict: bool = True) -> Dict[str, _Spec]:
@@ -213,14 +245,17 @@ def check(site: str, **ctx) -> None:
         call = sp.calls
         kind = sp.kind
         mode = sp.mode
+        nbytes = sp.nbytes
     _registry.inc("resilience.fault_injected")
     _registry.inc(f"resilience.fault_injected.{site}")
     ev = {"type": "fault", "site": site, "call": call, "mode": mode,
           "kind": kind}
+    if nbytes is not None:
+        ev["bytes"] = nbytes
     ev.update(ctx)
     _events.emit(ev)
     if kind == "oom":
-        raise InjectedResourceExhausted(site, call)
+        raise InjectedResourceExhausted(site, call, nbytes)
     if kind == "fatal":
         raise InjectedFatalFault(site, call, "injected fatal")
     raise InjectedFault(site, call)

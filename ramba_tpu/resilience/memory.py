@@ -1,0 +1,589 @@
+"""Memory-pressure governor: HBM budget, live-bytes ledger, spill, admission.
+
+The degradation ladder (PR 2) can only *react* to ``RESOURCE_EXHAUSTED``;
+this module exists so a flush that will not fit never reaches XLA in the
+first place — the peak-memory-aware scheduling discipline of
+"Memory-efficient array redistribution through portable collective
+communication" (arXiv:2112.01075) applied to the fuser:
+
+* **Budget** — per-device HBM capacity: ``RAMBA_HBM_BUDGET`` when set
+  (``common.parse_bytes`` grammar, e.g. ``4g``), else the device's own
+  ``memory_stats()["bytes_limit"]`` when the backend reports one (TPU/GPU
+  do, CPU does not), else *no budget* — the documented CPU-test default in
+  which the governor is fully disabled and the fused fast path runs with
+  zero overhead beyond ledger dict upkeep.
+* **Ledger** — live-bytes accounting for every realized ``Const`` leaf,
+  driven by the fuser's existing owner census (``owner_incref`` /
+  ``owner_decref``): entries are keyed by buffer identity and hold only
+  *weak* references to the owning Const nodes, so the ledger can never
+  itself pin HBM.
+* **Spill** — an LRU list of cold, non-pinned, fully-addressable arrays
+  that can be ``jax.device_get`` to host (``resilience.spill``) and are
+  transparently re-``device_put`` on next touch.  Never spilled: donated
+  leaves (owners == 0 means they are not in the ledger at all), pinned
+  in-flight flush leaves, and non-fully-addressable (multi-host) shards.
+* **Admission** — before a flush executes, its peak footprint is
+  estimated (XLA's own ``compiled.memory_analysis()`` via an AOT lowering
+  when it reports real numbers, else the analytic live-set walk in
+  ``analyze.rules.estimate_peak_bytes``; ``RAMBA_HBM_ESTIMATE=analytic``
+  forces the latter).  If ``live + peak`` crosses the watermark
+  (``RAMBA_HBM_WATERMARK``, default 0.9 of budget) the governor first
+  evicts spill candidates, then — if still over — routes the flush to the
+  ``chunked`` rung (byte-bounded segments, see ``fuser._run_chunked``)
+  instead of letting it OOM.
+* **OOM recovery** — ``retry.classify`` marks real and injected
+  ``RESOURCE_EXHAUSTED`` as the distinct ``oom`` class; the ladder calls
+  :func:`evict_for_oom` before dropping a rung, so recovery is
+  "evict → drop one rung → retry", not blind backoff.
+
+Everything observable lands on the observe stream: ``memory``-type
+watermark/evict/spill/restore/admit events and the gauges
+``memory.live_bytes``, ``memory.spilled_bytes``, ``memory.evictions``,
+``memory.admission_rejects``.
+
+Implementation note: expression nodes are normally immutable; the one
+sanctioned mutation in the codebase is the governor swapping a
+``Const.value`` between a device array and its :class:`~ramba_tpu.
+resilience.spill.SpilledArray` stand-in.  Both directions go through
+``fuser.owner_rekey`` so the donation census follows the buffer.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import re
+import weakref
+from typing import Optional
+
+from ramba_tpu import common as _common
+from ramba_tpu.observe import events as _events
+from ramba_tpu.observe import registry as _registry
+from ramba_tpu.resilience import spill as _spill
+
+
+def _nbytes(v) -> int:
+    try:
+        return int(v.nbytes)
+    except Exception:
+        return 0
+
+
+def _is_device_array(v) -> bool:
+    import jax
+
+    return isinstance(v, jax.Array)
+
+
+# ---------------------------------------------------------------------------
+# budget / watermark
+# ---------------------------------------------------------------------------
+
+# memory_stats() probe result: unset | int | None (backend reports nothing).
+_device_budget: object = "unset"
+
+
+def device_budget_bytes() -> Optional[int]:
+    """The backend-reported per-device HBM capacity, probed once."""
+    global _device_budget
+    if _device_budget == "unset":
+        limit = None
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats()
+            if stats:
+                limit = int(stats.get("bytes_limit") or 0) or None
+        except Exception:
+            limit = None
+        _device_budget = limit
+    return _device_budget  # type: ignore[return-value]
+
+
+def budget_bytes() -> Optional[int]:
+    """Effective per-device budget; None disables the governor entirely
+    (the documented default on CPU test backends, which report no
+    ``bytes_limit``)."""
+    raw = os.environ.get("RAMBA_HBM_BUDGET")
+    if raw:
+        try:
+            return max(1, _common.parse_bytes(raw))
+        except ValueError:
+            pass
+    return device_budget_bytes()
+
+
+def watermark_bytes(budget: Optional[int] = None) -> Optional[int]:
+    """Admission threshold: ``RAMBA_HBM_WATERMARK`` as a fraction of the
+    budget when ≤ 1.0, an absolute byte count otherwise; default 0.9."""
+    if budget is None:
+        budget = budget_bytes()
+    if budget is None:
+        return None
+    raw = os.environ.get("RAMBA_HBM_WATERMARK")
+    if raw:
+        try:
+            v = float(raw)
+            if 0.0 < v <= 1.0:
+                return int(budget * v)
+        except ValueError:
+            pass
+        try:
+            return max(1, _common.parse_bytes(raw))
+        except ValueError:
+            pass
+    return int(budget * 0.9)
+
+
+def chunk_target_bytes() -> int:
+    """Per-segment live-byte target for the ``chunked`` rung.  Derived
+    from the watermark when a budget is known; otherwise
+    ``RAMBA_CHUNK_BYTES`` (default 256 MiB) so the rung still works as a
+    plain ladder fallback on budgetless backends."""
+    raw = os.environ.get("RAMBA_CHUNK_BYTES")
+    if raw:
+        try:
+            return max(1, _common.parse_bytes(raw))
+        except ValueError:
+            pass
+    b = budget_bytes()
+    if b:
+        return max(1 << 16, (watermark_bytes(b) or b) // 4)
+    return 256 << 20
+
+
+# ---------------------------------------------------------------------------
+# the ledger
+# ---------------------------------------------------------------------------
+
+
+class _Entry:
+    __slots__ = ("key", "nbytes", "consts", "seq", "pins", "spilled")
+
+    def __init__(self, key: int, nbytes: int, seq: int, spilled: bool):
+        self.key = key          # id() of the current value object
+        self.nbytes = nbytes    # HBM footprint when resident
+        self.consts: list = []  # weakrefs to the owning Const nodes
+        self.seq = seq          # LRU clock: higher = touched more recently
+        self.pins = 0           # >0 while a flush holds this as a leaf
+        self.spilled = spilled
+
+
+class Ledger:
+    """Live-bytes accounting over every realized leaf buffer.
+
+    Holds no strong references to buffers or Consts — entries die with
+    the owner census (``on_release``) or when every owning Const is
+    garbage-collected, so the ledger can never leak HBM.
+    """
+
+    def __init__(self):
+        self.entries: dict = {}
+        self.live_bytes = 0
+        self.spilled_bytes = 0
+        self.peak_live_bytes = 0
+        self.evictions = 0
+        self.restores = 0
+        self._clock = itertools.count(1)
+
+    # -- census hooks (called from fuser.owner_incref/owner_decref) --------
+
+    def on_incref(self, const) -> None:
+        v = const.value
+        k = id(v)
+        e = self.entries.get(k)
+        if e is None:
+            spilled = isinstance(v, _spill.SpilledArray)
+            if not spilled and not _is_device_array(v):
+                return
+            e = _Entry(k, _nbytes(v), next(self._clock), spilled)
+            self.entries[k] = e
+            if spilled:
+                self.spilled_bytes += e.nbytes
+            else:
+                self.live_bytes += e.nbytes
+                if self.live_bytes > self.peak_live_bytes:
+                    self.peak_live_bytes = self.live_bytes
+        else:
+            e.seq = next(self._clock)
+        for r in e.consts:
+            if r() is const:
+                return
+        e.consts.append(weakref.ref(const))
+
+    def on_release(self, value) -> None:
+        e = self.entries.pop(id(value), None)
+        if e is None:
+            return
+        if e.spilled:
+            self.spilled_bytes -= e.nbytes
+        else:
+            self.live_bytes -= e.nbytes
+
+    def _drop(self, e: "_Entry") -> None:
+        """Remove an entry whose owners all died without a decref."""
+        self.entries.pop(e.key, None)
+        if e.spilled:
+            self.spilled_bytes -= e.nbytes
+        else:
+            self.live_bytes -= e.nbytes
+
+    # -- pinning (in-flight flush leaves are never spill candidates) -------
+
+    def pin_values(self, vals) -> list:
+        keys = []
+        for v in vals:
+            e = self.entries.get(id(v))
+            if e is not None:
+                e.pins += 1
+                e.seq = next(self._clock)
+                keys.append(e.key)
+        return keys
+
+    def unpin(self, keys) -> None:
+        for k in keys:
+            e = self.entries.get(k)
+            if e is not None and e.pins > 0:
+                e.pins -= 1
+
+    def touch(self, value) -> None:
+        e = self.entries.get(id(value))
+        if e is not None:
+            e.seq = next(self._clock)
+
+    # -- spill / restore ----------------------------------------------------
+
+    def _live_consts(self, e: "_Entry") -> list:
+        return [c for c in (r() for r in e.consts) if c is not None]
+
+    def _spill_entry(self, e: "_Entry") -> int:
+        """Spill one resident entry to host.  Returns HBM bytes freed."""
+        if e.spilled or e.pins:
+            return 0
+        consts = self._live_consts(e)
+        if not consts:
+            self._drop(e)
+            return 0
+        v = consts[0].value
+        if not _is_device_array(v):
+            return 0
+        try:
+            if v.is_deleted() or not v.is_fully_addressable:
+                return 0
+        except Exception:
+            return 0
+        if e.nbytes <= 0:
+            return 0
+        wrapper = _spill.spill_to_host(v)
+        for c in consts:
+            c.value = wrapper
+        from ramba_tpu.core import fuser as _fuser
+
+        _fuser.owner_rekey(v, wrapper)
+        del self.entries[e.key]
+        e.key = id(wrapper)
+        e.consts = [weakref.ref(c) for c in consts]
+        e.spilled = True
+        self.entries[e.key] = e
+        self.live_bytes -= e.nbytes
+        self.spilled_bytes += e.nbytes
+        self.evictions += 1
+        _registry.inc("memory.evictions")
+        _update_gauges(self)
+        _events.emit({
+            "type": "memory", "action": "spill", "bytes": e.nbytes,
+            "shape": list(wrapper.shape), "dtype": str(wrapper.dtype),
+            "live_bytes": self.live_bytes,
+            "spilled_bytes": self.spilled_bytes,
+        })
+        return e.nbytes
+
+    def restore(self, const):
+        """Bring a spilled Const back onto the device (all sibling Consts
+        sharing the buffer are updated) and return the jax.Array."""
+        wrapper = const.value
+        if not isinstance(wrapper, _spill.SpilledArray):
+            return wrapper
+        e = self.entries.get(id(wrapper))
+        arr = _spill.restore_to_device(wrapper)
+        consts = self._live_consts(e) if e is not None else []
+        if not any(c is const for c in consts):
+            consts.append(const)
+        for c in consts:
+            c.value = arr
+        from ramba_tpu.core import fuser as _fuser
+
+        _fuser.owner_rekey(wrapper, arr)
+        nbytes = _nbytes(arr) or wrapper.device_nbytes
+        if e is not None:
+            del self.entries[e.key]
+            e.key = id(arr)
+            e.consts = [weakref.ref(c) for c in consts]
+            e.spilled = False
+            e.seq = next(self._clock)
+            self.entries[e.key] = e
+            self.spilled_bytes -= e.nbytes
+            e.nbytes = nbytes
+            self.live_bytes += e.nbytes
+            if self.live_bytes > self.peak_live_bytes:
+                self.peak_live_bytes = self.live_bytes
+        self.restores += 1
+        _registry.inc("memory.restores")
+        _update_gauges(self)
+        _events.emit({
+            "type": "memory", "action": "restore", "bytes": nbytes,
+            "live_bytes": self.live_bytes,
+            "spilled_bytes": self.spilled_bytes,
+        })
+        return arr
+
+    def evict_until(self, need: int) -> int:
+        """Spill LRU-coldest candidates until ``need`` bytes are freed (or
+        candidates run out).  Returns bytes actually freed."""
+        freed = 0
+        cands = [e for e in list(self.entries.values())
+                 if not e.spilled and not e.pins]
+        cands.sort(key=lambda e: e.seq)
+        for e in cands:
+            if freed >= need:
+                break
+            freed += self._spill_entry(e)
+        return freed
+
+    # -- reporting ----------------------------------------------------------
+
+    def snapshot(self, top: int = 5) -> dict:
+        rows = []
+        pinned = 0
+        for e in list(self.entries.values()):
+            consts = self._live_consts(e)
+            if not consts:
+                self._drop(e)
+                continue
+            if e.pins and not e.spilled:
+                pinned += e.nbytes
+            v = consts[0].value
+            rows.append({
+                "nbytes": e.nbytes,
+                "shape": list(getattr(v, "shape", ())),
+                "dtype": str(getattr(v, "dtype", "?")),
+                "spilled": e.spilled,
+                "pinned": e.pins,
+                "owners": len(consts),
+            })
+        rows.sort(key=lambda r: r["nbytes"], reverse=True)
+        _update_gauges(self)
+        return {
+            "budget_bytes": budget_bytes(),
+            "watermark_bytes": watermark_bytes(),
+            "live_bytes": self.live_bytes,
+            "spilled_bytes": self.spilled_bytes,
+            "pinned_bytes": pinned,
+            "peak_live_bytes": self.peak_live_bytes,
+            "evictions": self.evictions,
+            "restores": self.restores,
+            "arrays": len(rows),
+            "top": rows[:top],
+        }
+
+
+def _update_gauges(led: "Ledger") -> None:
+    _registry.gauge("memory.live_bytes", led.live_bytes)
+    _registry.gauge("memory.spilled_bytes", led.spilled_bytes)
+
+
+#: Process-wide ledger singleton (the fuser census hooks feed this).
+ledger = Ledger()
+
+
+def reset() -> None:
+    """Forget all accounting (tests).  Does NOT restore spilled arrays."""
+    global ledger, _device_budget
+    ledger = Ledger()
+    _device_budget = "unset"
+    _est_memo.clear()
+
+
+# ---------------------------------------------------------------------------
+# footprint estimation
+# ---------------------------------------------------------------------------
+
+_est_memo: dict = {}
+_EST_MEMO_MAX = 256
+
+
+def _leaf_avals(leaf_vals) -> list:
+    import jax
+    import numpy as np
+
+    avals = []
+    for v in leaf_vals:
+        if _is_device_array(v):
+            try:
+                avals.append(
+                    jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=v.sharding)
+                )
+                continue
+            except Exception:
+                pass
+        a = np.asarray(v)
+        avals.append(jax.ShapeDtypeStruct(a.shape, a.dtype))
+    return avals
+
+
+def _xla_estimate(program, avals) -> Optional[int]:
+    """XLA's own numbers via an AOT lowering (the ``analyze_pending``
+    pattern): argument + output + temp sizes.  Returns None when the
+    backend reports nothing usable (CPU typically reports zeros)."""
+    import jax
+
+    from ramba_tpu.core import fuser as _fuser
+
+    compiled = jax.jit(_fuser._build_callable(program)).lower(*avals).compile()
+    ma = compiled.memory_analysis()
+    total = 0
+    for name in ("argument_size_in_bytes", "output_size_in_bytes",
+                 "temp_size_in_bytes"):
+        v = getattr(ma, name, None)
+        if v:
+            total += int(v)
+    return total if total > 0 else None
+
+
+def estimate_program_bytes(program, leaf_vals, donate=()) -> int:
+    """Peak device footprint estimate for one linearized program.
+
+    Prefers ``compiled.memory_analysis()`` (memoized per structure+avals —
+    the AOT compile is paid once per program shape, and jax's own
+    executable cache makes the later ``jax.jit`` call cheap); falls back
+    to the analytic live-set walk in ``analyze.rules`` when XLA reports
+    nothing (CPU) or ``RAMBA_HBM_ESTIMATE=analytic`` forces determinism.
+    """
+    avals = _leaf_avals(leaf_vals)
+    fp = (program.key, tuple(donate),
+          tuple((tuple(a.shape), str(a.dtype)) for a in avals))
+    cached = _est_memo.get(fp)
+    if cached is not None:
+        return cached
+    est: Optional[int] = None
+    if os.environ.get("RAMBA_HBM_ESTIMATE", "") != "analytic":
+        try:
+            est = _xla_estimate(program, avals)
+        except Exception:
+            est = None
+    if est is None:
+        from ramba_tpu.analyze import rules as _rules
+
+        est = _rules.estimate_peak_bytes(program, avals, donate)
+    if len(_est_memo) >= _EST_MEMO_MAX:
+        _est_memo.clear()
+    _est_memo[fp] = est
+    return est
+
+
+# ---------------------------------------------------------------------------
+# admission control + oom recovery
+# ---------------------------------------------------------------------------
+
+
+def admit(program, leaf_vals, donate_key, span: Optional[dict] = None) -> bool:
+    """Pre-flush admission check.  Returns True when the flush should be
+    routed to the ``chunked`` rung (it does not fit under the watermark
+    even after eviction); False admits the fused path.  No-op (False)
+    when no budget is known."""
+    budget = budget_bytes()
+    if budget is None:
+        return False
+    wm = watermark_bytes(budget) or budget
+    est = estimate_program_bytes(program, leaf_vals, donate_key)
+    # ledger.live already counts this flush's resident leaves; the program
+    # estimate counts its arguments too — subtract the overlap so leaves
+    # are not double-billed.
+    resident = 0
+    seen: set = set()
+    for v in leaf_vals:
+        k = id(v)
+        if k in seen:
+            continue
+        seen.add(k)
+        e = ledger.entries.get(k)
+        if e is not None and not e.spilled:
+            resident += e.nbytes
+    other = max(0, ledger.live_bytes - resident)
+    projected = other + est
+    if span is not None:
+        span["mem_live_bytes"] = ledger.live_bytes
+        span["mem_peak_est"] = est
+    _update_gauges(ledger)
+    _events.emit({
+        "type": "memory", "action": "admit", "est_bytes": est,
+        "live_bytes": ledger.live_bytes, "projected_bytes": projected,
+        "watermark_bytes": wm, "budget_bytes": budget,
+        "ok": projected <= wm,
+    })
+    if projected <= wm:
+        return False
+    _events.emit({
+        "type": "memory", "action": "watermark",
+        "over_bytes": projected - wm, "watermark_bytes": wm,
+    })
+    freed = ledger.evict_until(projected - wm)
+    if projected - freed <= wm:
+        if span is not None:
+            span["admission"] = "evicted"
+        return False
+    _registry.inc("memory.admission_rejects")
+    _registry.gauge("memory.admission_rejects.last_over_bytes",
+                    projected - freed - wm)
+    _events.emit({
+        "type": "memory", "action": "reject", "route": "chunked",
+        "est_bytes": est, "freed_bytes": freed,
+        "over_bytes": projected - freed - wm,
+    })
+    if span is not None:
+        span["admission"] = "chunked"
+    return True
+
+
+_OOM_BYTES_RE = re.compile(r"(\d{4,})\s*bytes|[Aa]llocating\s+(\d+)")
+
+
+def evict_for_oom(exc: BaseException) -> int:
+    """Ladder hook for oom-class failures: free at least the amount the
+    error asked for (injected faults carry ``.bytes``; real XLA messages
+    usually name the allocation size), or everything unpinned when the
+    size is unknown.  Returns bytes freed."""
+    need = getattr(exc, "bytes", None)
+    if not need:
+        m = _OOM_BYTES_RE.search(str(exc))
+        if m:
+            need = int(m.group(1) or m.group(2))
+    if not need:
+        need = ledger.live_bytes or 1
+    freed = ledger.evict_until(int(need))
+    _events.emit({
+        "type": "memory", "action": "oom_evict", "need_bytes": int(need),
+        "freed_bytes": freed, "live_bytes": ledger.live_bytes,
+    })
+    return freed
+
+
+# ---------------------------------------------------------------------------
+# module-level conveniences used by the fuser hot path
+# ---------------------------------------------------------------------------
+
+
+def on_incref(const) -> None:
+    ledger.on_incref(const)
+
+
+def on_release(value) -> None:
+    ledger.on_release(value)
+
+
+def restore(const):
+    return ledger.restore(const)
+
+
+def is_spilled(value) -> bool:
+    return isinstance(value, _spill.SpilledArray)

@@ -4,11 +4,12 @@ Every wrapped call site (``flush`` compile/execute, checkpoint I/O,
 fileio reads/writes, ``distributed.initialize``) funnels through
 :func:`call`, which:
 
-1. classifies each failure as ``retryable`` / ``degrade`` / ``fatal``
-   (:func:`classify`) — programming errors propagate unchanged so
-   existing error-path behavior is untouched; device-memory exhaustion
-   is pointless to retry identically and is handed to the degradation
-   ladder instead;
+1. classifies each failure as ``retryable`` / ``degrade`` / ``oom`` /
+   ``fatal`` (:func:`classify`) — programming errors propagate unchanged
+   so existing error-path behavior is untouched; device-memory
+   exhaustion (``oom``) is pointless to retry identically and is handed
+   to the degradation ladder, which evicts spill candidates
+   (``memory.evict_for_oom``) before dropping a rung;
 2. sleeps exponential backoff with *deterministic* jitter (a hash of
    seed × site × attempt, not wall-clock randomness) so multi-controller
    ranks back off identically and reruns reproduce;
@@ -53,7 +54,7 @@ _RETRYABLE_MARKERS = (
     "Connection refused", "Connection reset", "Broken pipe",
     "Socket closed", "connection attempt timed out",
 )
-_DEGRADE_MARKERS = (
+_OOM_MARKERS = (
     "RESOURCE_EXHAUSTED", "out of memory", "Out of memory", "OutOfMemory",
     "Resource exhausted",
 )
@@ -67,11 +68,14 @@ _FATAL_OS_ERRORS = (
 def classify(exc: BaseException) -> str:
     """Sort an exception into ``"retryable"`` (back off and re-attempt in
     place), ``"degrade"`` (re-attempting identically is pointless — move
-    down the ladder), or ``"fatal"`` (propagate unchanged)."""
+    down the ladder), ``"oom"`` (device memory exhaustion, real or
+    injected: degrade-worthy, but recoverable by evicting HBM first —
+    the ladder runs ``memory.evict_for_oom`` before the rung drop), or
+    ``"fatal"`` (propagate unchanged)."""
     if isinstance(exc, RetryBudgetExhausted):
         return "degrade"
     if isinstance(exc, _faults.InjectedResourceExhausted):
-        return "degrade"
+        return "oom"
     if isinstance(exc, _faults.InjectedFault):
         return "retryable" if exc.retryable else "fatal"
     if isinstance(exc, _FATAL_OS_ERRORS):
@@ -79,9 +83,9 @@ def classify(exc: BaseException) -> str:
     if isinstance(exc, (OSError, TimeoutError, ConnectionError)):
         return "retryable"
     msg = str(exc)
-    for marker in _DEGRADE_MARKERS:
+    for marker in _OOM_MARKERS:
         if marker in msg:
-            return "degrade"
+            return "oom"
     for marker in _RETRYABLE_MARKERS:
         if marker in msg:
             return "retryable"

@@ -1,0 +1,92 @@
+"""Host-spill primitives: move a cold device array to host RAM and back.
+
+The memory governor (``resilience.memory``) decides *when* to spill; this
+module owns *how*.  A spilled array is represented by a
+:class:`SpilledArray` wrapper holding the host copy plus the original
+sharding, so the governor can swap it into the owning ``Const`` leaves and
+restore an identically-sharded ``jax.Array`` on the next touch.  The
+wrapper quacks just enough like an array (``shape``/``dtype``/``nbytes``/
+``__array__``) that host-side consumers — ``np.asarray`` on an index
+operand, the host execution rung, diagnostics — can read the bytes
+without forcing a device round-trip.
+
+Spill is restricted by the governor to fully-addressable arrays (every
+shard on this process's devices), so plain ``jax.device_get`` /
+``jax.device_put(host, sharding)`` round-trips the value exactly; under
+multi-controller SPMD no single process holds the global array and the
+governor never offers such arrays as candidates.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ramba_tpu.utils import timing as _timing
+
+
+class SpilledArray:
+    """Host-resident stand-in for a device array evicted from HBM.
+
+    Sits in a ``Const.value`` slot in place of the ``jax.Array`` it
+    replaced; the fuser restores it to the device (via
+    ``resilience.memory.restore``) before the value is next used in a
+    compiled program.
+    """
+
+    __slots__ = ("host", "sharding", "device_nbytes", "__weakref__")
+
+    def __init__(self, host: np.ndarray, sharding, device_nbytes: int):
+        self.host = host
+        self.sharding = sharding
+        # Size the buffer occupied in HBM (what eviction freed) — may
+        # differ from host.nbytes under padding; 0 means unknown.
+        self.device_nbytes = int(device_nbytes) or int(host.nbytes)
+
+    @property
+    def shape(self):
+        return self.host.shape
+
+    @property
+    def dtype(self):
+        return self.host.dtype
+
+    @property
+    def nbytes(self):
+        return self.device_nbytes
+
+    @property
+    def ndim(self):
+        return self.host.ndim
+
+    def __array__(self, dtype=None, copy=None):
+        a = self.host
+        return a.astype(dtype) if dtype is not None else a
+
+    def __repr__(self):
+        return (f"SpilledArray(shape={self.host.shape}, "
+                f"dtype={self.host.dtype}, nbytes={self.device_nbytes})")
+
+
+def spill_to_host(value) -> SpilledArray:
+    """Device → host: copy ``value`` out of HBM and wrap it.  The device
+    buffer is freed once the caller drops every reference to ``value``
+    (the governor rewrites all owning Const leaves)."""
+    import jax
+
+    sharding = value.sharding
+    try:
+        nbytes = int(value.nbytes)
+    except Exception:
+        nbytes = 0
+    host = np.asarray(jax.device_get(value))
+    _timing.note_transfer("device_to_host", host.nbytes)
+    return SpilledArray(host, sharding, nbytes)
+
+
+def restore_to_device(sp: SpilledArray):
+    """Host → device: re-upload with the original sharding."""
+    import jax
+
+    out = jax.device_put(sp.host, sp.sharding)
+    _timing.note_transfer("host_to_device", sp.host.nbytes)
+    return out

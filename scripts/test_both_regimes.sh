@@ -12,3 +12,5 @@ echo "=== leg 3: RAMBA_VERIFY=1 (strict flush-time program verifier) ==="
 RAMBA_VERIFY=1 python -m pytest tests/ -q "$@"
 echo "=== leg 4: 2-process fault injection (RAMBA_FAULTS=compile:once) ==="
 python scripts/two_process_suite.py --fault-leg
+echo "=== leg 5: 2-process memory governor (tiny RAMBA_HBM_BUDGET) ==="
+python scripts/two_process_suite.py --memory-leg

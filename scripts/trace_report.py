@@ -15,7 +15,11 @@ Prints, per input:
     rate, instructions, bytes in (leaves) and out (roots),
   * rewrite-rule fire totals,
   * the degradation timeline (injected faults, retries, ladder rung
-    transitions fused→split→eager→host, recoveries — newest last), and
+    transitions fused→split→chunked→eager→host, recoveries — newest
+    last),
+  * the memory timeline (admission checks, watermark crossings, spills,
+    restores, oom evictions) with a peak-live column in the flush
+    totals, and
   * the top programs by cumulative wall time.
 """
 
@@ -75,6 +79,7 @@ def report(path: str, events: list, top: int = 10, file=None) -> None:
             print(f"  error: {h['error']}", file=file)
 
     _degradation_timeline(events, file=file)
+    _memory_timeline(events, file=file)
     _findings_summary(events, file=file)
 
     flushes = [e for e in events if e.get("type") == "flush"]
@@ -105,10 +110,14 @@ def report(path: str, events: list, top: int = 10, file=None) -> None:
         f"instrs: {instrs}  segments: {segs}  donated bufs: {donated}",
         file=file,
     )
-    print(
-        f"bytes: in {_fmt_bytes(leaf_b)}  out {_fmt_bytes(out_b)}",
-        file=file,
-    )
+    peak_live = max((f.get("mem_live_bytes", 0) or 0) for f in flushes)
+    peak_est = max((f.get("mem_peak_est", 0) or 0) for f in flushes)
+    line = f"bytes: in {_fmt_bytes(leaf_b)}  out {_fmt_bytes(out_b)}"
+    if peak_live or peak_est:
+        line += f"  peak live {_fmt_bytes(peak_live)}"
+        if peak_est:
+            line += f"  peak est {_fmt_bytes(peak_est)}"
+    print(line, file=file)
 
     fires = defaultdict(int)
     for f in flushes:
@@ -201,6 +210,58 @@ def _degradation_timeline(events: list, file=None, cap: int = 50) -> None:
     faults = sum(1 for e in degr if e.get("type") == "fault")
     print(f"degradation totals: faults={faults} retries={retries} "
           f"rung-steps={rungs}", file=file)
+
+
+def _memory_timeline(events: list, file=None, cap: int = 50) -> None:
+    """Chronological memory-governor lines (admission checks that crossed
+    the watermark, spills, restores, oom evictions), timestamped relative
+    to the first event in the trace.  Plain in-budget admits are elided —
+    they would drown the interesting lines one-per-flush."""
+    file = file or sys.stdout
+    mem = [e for e in events if e.get("type") == "memory"]
+    if not mem:
+        return
+    shown = [e for e in mem if not (e.get("action") == "admit" and e.get("ok"))]
+    stamps = [e["ts"] for e in events if isinstance(e.get("ts"), (int, float))]
+    t0 = min(stamps) if stamps else None
+    admits = sum(1 for e in mem if e.get("action") == "admit")
+    print(f"memory timeline ({len(mem)} events, {admits} admission checks):",
+          file=file)
+    for e in shown[:cap]:
+        rel = (f"+{e['ts'] - t0:8.3f}s"
+               if t0 is not None and isinstance(e.get("ts"), (int, float))
+               else " " * 10)
+        action = e.get("action", "?")
+        if action == "admit":
+            line = (f"admit     projected="
+                    f"{_fmt_bytes(e.get('projected_bytes', 0))} "
+                    f"est={_fmt_bytes(e.get('est_bytes', 0))} over budget")
+        elif action == "watermark":
+            line = (f"watermark over={_fmt_bytes(e.get('over_bytes', 0))} "
+                    f"wm={_fmt_bytes(e.get('watermark_bytes', 0))}")
+        elif action == "spill":
+            line = (f"spill     {_fmt_bytes(e.get('bytes', 0))} "
+                    f"-> host (live {_fmt_bytes(e.get('live_bytes', 0))})")
+        elif action == "restore":
+            line = (f"restore   {_fmt_bytes(e.get('bytes', 0))} "
+                    f"-> device (live {_fmt_bytes(e.get('live_bytes', 0))})")
+        elif action == "oom_evict":
+            line = (f"oom-evict need={_fmt_bytes(e.get('need_bytes', 0))} "
+                    f"freed={_fmt_bytes(e.get('freed_bytes', 0))}")
+        elif action == "reject":
+            line = (f"reject    over={_fmt_bytes(e.get('over_bytes', 0))} "
+                    f"freed={_fmt_bytes(e.get('freed_bytes', 0))} "
+                    f"route={e.get('route', '?')}")
+        else:
+            line = action
+        print(f"  {rel}  {line}", file=file)
+    if len(shown) > cap:
+        print(f"  ... and {len(shown) - cap} more", file=file)
+    spills = sum(1 for e in mem if e.get("action") == "spill")
+    restores = sum(1 for e in mem if e.get("action") == "restore")
+    rejects = sum(1 for e in mem if e.get("action") == "reject")
+    print(f"memory totals: spills={spills} restores={restores} "
+          f"rejects={rejects}", file=file)
 
 
 def main(argv=None) -> int:

@@ -27,6 +27,16 @@ workload under ``RAMBA_FAULTS=compile:once`` — both ranks must inject the
 fault in lockstep, retry the flush, produce the correct result, count
 ``resilience.retries`` >= 1, and stream fault/degrade events into their
 per-rank RAMBA_TRACE files.
+
+``--memory-leg`` runs the memory-governor acceptance leg: the same 2-rank
+SPMD topology under a deliberately tiny ``RAMBA_HBM_BUDGET`` so pre-flush
+admission control must fire on both ranks in lockstep (SPMD: the analytic
+estimate is a pure function of the program, so both ranks route to the
+``chunked`` rung together), produce the correct result, and stream
+``memory`` events into the per-rank traces.  Host spill is intentionally
+NOT exercised here: multi-controller arrays are not fully addressable, so
+the governor refuses to spill them (memory.py) — the leg asserts the
+admission/chunked path, which is the part that must stay rank-lockstepped.
 """
 
 from __future__ import annotations
@@ -64,6 +74,126 @@ c = diagnostics.counters()
 assert c.get('resilience.retries', 0) >= 1, c
 print('FAULT_LEG_OK rank=%d retries=%d' % (rank, c['resilience.retries']))
 """
+
+
+# SPMD workload for the memory leg: each rank forms the process group,
+# runs a multi-op chain whose analytic peak estimate exceeds the tiny
+# injected HBM budget, and checks that admission control rerouted the
+# flush to the chunked rung while still producing the right answer.
+# argv: <rank> <coordinator>.
+_MEMORY_WORKLOAD = """
+import sys
+import numpy as np
+rank, coord = int(sys.argv[1]), sys.argv[2]
+from ramba_tpu.parallel import distributed
+distributed.initialize(coordinator_address=coord, num_processes=2,
+                       process_id=rank)
+import jax
+assert jax.process_count() == 2, jax.process_count()
+import ramba_tpu as rt
+a = rt.arange(65536) * 2.0 + 1.0
+b = rt.sqrt(a) + a * 0.5
+s = float(rt.sum(b))
+an = np.arange(65536) * 2.0 + 1.0
+exp = float(np.sum(np.sqrt(an) + an * 0.5))
+assert abs(s - exp) <= 1e-3 * abs(exp), (s, exp)
+from ramba_tpu import diagnostics
+c = diagnostics.counters()
+ok = (c.get('memory.admission_rejects', 0) >= 1
+      or c.get('memory.evictions', 0) >= 1)
+assert ok, c
+chunked = [f for f in diagnostics.last_flushes(20)
+           if f.get('admission') == 'chunked'
+           or f.get('degraded') == 'chunked']
+assert chunked, diagnostics.last_flushes(20)
+print('MEMORY_LEG_OK rank=%d rejects=%d' % (
+    rank, c.get('memory.admission_rejects', 0)))
+"""
+
+
+def run_memory_leg() -> int:
+    """Two ranks under a tiny HBM budget; admission control must route
+    both to the chunked rung, in lockstep, with the correct result."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    basetemp = tempfile.mkdtemp(prefix="ramba_2proc_mem_")
+    trace_base = os.path.join(basetemp, "trace.jsonl")
+    budget = float(os.environ.get("RAMBA_TEST_PROCS_TIMEOUT", "600"))
+
+    procs, logs = [], []
+    for rank in range(2):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        for k in ("RAMBA_TEST_PROCS", "RAMBA_TEST_PROC_ID",
+                  "RAMBA_TEST_COORD", "RAMBA_TEST_SHARED_TMP",
+                  "RAMBA_PROFILE_DIR", "RAMBA_FAULTS"):
+            env.pop(k, None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        # Tiny budget: the 65536-elem f32 chain estimates ~768 KB peak,
+        # far over a 100 KB budget, so admission must reject pre-flush.
+        env["RAMBA_HBM_BUDGET"] = "100k"
+        env["RAMBA_HBM_ESTIMATE"] = "analytic"
+        env["RAMBA_TRACE"] = trace_base
+        log = open(os.path.join(basetemp, f"rank{rank}.log"), "w")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _MEMORY_WORKLOAD, str(rank),
+             f"localhost:{port}"],
+            env=env, stdout=log, stderr=subprocess.STDOUT, cwd=REPO,
+        ))
+
+    deadline = time.time() + budget
+    rcs = [None, None]
+    try:
+        for i, p in enumerate(procs):
+            left = max(5.0, deadline - time.time())
+            try:
+                rcs[i] = p.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                rcs[i] = -9
+    finally:
+        for log in logs:
+            log.close()
+
+    ok = all(rc == 0 for rc in rcs)
+
+    # Per-rank traces must show the admission rejection routing to the
+    # chunked rung — the memory timeline works under SPMD.
+    import json
+
+    for rank in range(2):
+        path = f"{trace_base}.rank{rank}"
+        try:
+            with open(path) as f:
+                evs = [json.loads(ln) for ln in f if ln.strip()]
+            n_mem = sum(1 for e in evs if e.get("type") == "memory")
+            n_reject = sum(1 for e in evs if e.get("type") == "memory"
+                           and e.get("action") == "reject")
+            print(f"memory leg rank {rank}: {len(evs)} events, "
+                  f"{n_mem} memory, {n_reject} rejects")
+            if n_mem == 0 or n_reject == 0:
+                print(f"memory leg rank {rank}: FAIL "
+                      f"(memory={n_mem}, reject={n_reject})")
+                ok = False
+        except (OSError, ValueError) as e:
+            print(f"memory leg rank {rank}: FAIL ({e})")
+            ok = False
+
+    for rank in range(2):
+        path = os.path.join(basetemp, f"rank{rank}.log")
+        with open(path) as f:
+            tail = f.read().splitlines()
+        if "MEMORY_LEG_OK rank=%d" % rank not in "\n".join(tail):
+            ok = False
+        print(f"--- memory leg rank {rank} rc={rcs[rank]} ({path}) ---")
+        print("\n".join(tail[-(4 if ok else 40):]))
+    print(f"two-process memory leg: {'OK' if ok else 'FAIL'}")
+    if ok:
+        shutil.rmtree(basetemp, ignore_errors=True)
+    return 0 if ok else 1
 
 
 def run_fault_leg() -> int:
@@ -152,6 +282,8 @@ def run_fault_leg() -> int:
 def main() -> int:
     if "--fault-leg" in sys.argv[1:]:
         return run_fault_leg()
+    if "--memory-leg" in sys.argv[1:]:
+        return run_memory_leg()
     pytest_args = sys.argv[1:] or ["tests/"]
     with socket.socket() as s:
         s.bind(("localhost", 0))

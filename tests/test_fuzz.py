@@ -173,6 +173,23 @@ def test_random_program_verified_strict(seed, monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# Memory-pressure leg: the same random programs must survive seeded device
+# OOM — each compiled execute has a 20% (seed-deterministic) chance of
+# RESOURCE_EXHAUSTED, so the ladder's evict → drop-rung → retry path runs
+# on arbitrary program shapes and must still converge to numpy's answer.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(0, 40, 5))
+def test_random_program_survives_seeded_oom(seed, monkeypatch):
+    from ramba_tpu.resilience import faults
+
+    monkeypatch.setenv("RAMBA_RETRY_BASE_S", "0.001")
+    with faults.active("execute:0.2:oom", seed=seed):
+        _check(seed)
+
+
+# ---------------------------------------------------------------------------
 # Mutation + manipulation fuzz: setitem, masked writes, fancy indexing,
 # concatenate/stack/pad/roll/sort/take — the reference's other test axis
 # (test_distributed_array.py drives slicing/assignment heavily).

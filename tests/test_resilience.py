@@ -7,8 +7,8 @@ Covers ``ramba_tpu.resilience`` plus its integrations:
 * retry engine: budgets, exponential backoff determinism, retryable vs
   degrade vs fatal classification, budget exhaustion with the original
   error chained,
-* the flush degradation ladder fused → split → eager → host with
-  counters asserted via ``observe.registry`` and the degraded rung
+* the flush degradation ladder fused → split → chunked → eager → host
+  with counters asserted via ``observe.registry`` and the degraded rung
   recorded in the flush span,
 * atomic checkpointing (a crashed save never corrupts the published
   checkpoint; ``CheckpointCorruptError`` on unreadable/mismatched
@@ -133,9 +133,11 @@ def test_classify():
     assert retry.classify(FileNotFoundError("gone")) == "fatal"
     assert retry.classify(PermissionError("no")) == "fatal"
     assert retry.classify(OSError("disk hiccup")) == "retryable"
+    # real and injected RESOURCE_EXHAUSTED are the distinct oom class:
+    # recoverable by eviction, never retried blindly in place
     assert retry.classify(
         RuntimeError("RESOURCE_EXHAUSTED: out of memory allocating")
-    ) == "degrade"
+    ) == "oom"
     assert retry.classify(RuntimeError("UNAVAILABLE: socket closed")) \
         == "retryable"
     # lowercase English prose must NOT look like a gRPC status code
@@ -146,7 +148,7 @@ def test_classify():
     assert retry.classify(retry.RetryBudgetExhausted("x")) == "degrade"
     assert retry.classify(faults.InjectedFault("s", 1)) == "retryable"
     assert retry.classify(faults.InjectedResourceExhausted("s", 1)) \
-        == "degrade"
+        == "oom"
 
 
 def test_retry_recovers_and_records_health():
@@ -439,6 +441,33 @@ def test_checkpoint_restore_target_mismatch(tmp_path):
     ok = jax.ShapeDtypeStruct((64,), saved_dtype, sharding=sh)
     with pytest.raises(checkpoint.CheckpointCorruptError):
         checkpoint.restore(p, {"w": ok, "extra": ok})  # structure mismatch
+
+
+@pytest.mark.skipif(_MULTIPROC, reason="spill requires fully-addressable "
+                    "arrays (single-controller)")
+def test_checkpoint_of_spilled_array_round_trips(tmp_path):
+    # An array the memory governor evicted to host must still checkpoint:
+    # the save path touches the leaf, which transparently restores it to
+    # the device, and the bytes round-trip exactly.
+    pytest.importorskip("orbax.checkpoint")
+    from ramba_tpu import checkpoint
+    from ramba_tpu.resilience import memory, spill
+
+    fuser.flush()
+    data = np.arange(512, dtype=np.float64) * 1.5
+    w = rt.fromarray(data)
+    rt.sync()
+    assert isinstance(w._expr.value, _jax.Array)
+    freed = memory.ledger.evict_until(memory.ledger.live_bytes or 1)
+    assert freed > 0, "nothing was spilled"
+    assert isinstance(w._expr.value, spill.SpilledArray)
+    p = _ck(tmp_path, "ck_spilled")
+    checkpoint.save(p, {"w": w})
+    # the save touched the leaf -> it is resident again
+    assert isinstance(w._expr.value, _jax.Array)
+    back = checkpoint.restore(p)
+    np.testing.assert_array_equal(np.asarray(back["w"]), np.asarray(w))
+    np.testing.assert_array_equal(np.asarray(w), data.astype(np.asarray(w).dtype))
 
 
 # -- fileio ------------------------------------------------------------------
