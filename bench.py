@@ -488,6 +488,21 @@ def main():
     except Exception:  # noqa: BLE001 - never let bookkeeping break the JSON
         pass
 
+    # RAMBA_PERF: structured per-compiled-kernel cost section (compile /
+    # rolling execute stats, bytes, cache churn, rungs, cost_analysis
+    # flops) — the capture scripts/perf_diff.py gates the BENCH_r*.json
+    # trajectory on.
+    try:
+        if os.environ.get("RAMBA_PERF"):
+            from ramba_tpu import diagnostics as _diag
+
+            perf = _diag.perf_report()
+            out["kernels"] = perf["kernels"]
+            out["flushes"] = perf["flushes"]
+            out["slow_flushes"] = perf["slow_flushes"]
+    except Exception:  # noqa: BLE001 - never let bookkeeping break the JSON
+        pass
+
     # Persist/recall the last successful on-TPU run: the tunneled chip can
     # be unreachable for hours (round-4 postmortem: a killed client wedged
     # the relay lease), so a CPU-fallback OR total-failure line also

@@ -39,6 +39,7 @@ import jax
 from ramba_tpu import common
 from ramba_tpu.core.expr import Const, Expr, Node, Scalar, OPS
 from ramba_tpu.observe import events as _events
+from ramba_tpu.observe import ledger as _ledger
 from ramba_tpu.observe import profile as _profile
 from ramba_tpu.observe import registry as _registry
 from ramba_tpu.parallel import mesh as _mesh
@@ -76,10 +77,11 @@ _const_owners: dict[int, int] = {}
 
 _nodes_since_flush = 0
 
-# Bounded FIFO compile cache; entries from an old mesh epoch are purged on
+# Bounded LRU compile cache; entries from an old mesh epoch are purged on
 # the first flush after set_mesh (their sharding constraints baked in the old
 # mesh), and user-function keys (fromfunction/apply statics) can't pin
-# unbounded executables.
+# unbounded executables.  dict preserves insertion order and a hit re-inserts
+# its key, so iteration order IS recency order and eviction pops the LRU.
 _compile_cache: "dict" = {}
 _COMPILE_CACHE_MAX = 512
 _cache_epoch = 0
@@ -303,24 +305,38 @@ def _cache_key(program: _Program, donate_key: tuple) -> tuple:
 
 
 def _get_compiled(program: _Program, donate_key: tuple):
-    """Compile-cache lookup (mesh-epoch aware).  Returns (fn, is_new)."""
+    """Compile-cache lookup (mesh-epoch aware, true LRU).  Returns
+    ``(fn, is_new, fingerprint)`` where ``fingerprint`` is the stable
+    per-kernel key the cost ledger files this program under."""
     global _cache_epoch
     if _cache_epoch != _mesh.mesh_epoch:
         _compile_cache.clear()
         _cache_epoch = _mesh.mesh_epoch
     key = _cache_key(program, donate_key)
-    fn = _compile_cache.get(key)
+    fp = _ledger.fingerprint(key)
+    fn = _compile_cache.pop(key, None)
     if fn is not None:
+        _compile_cache[key] = fn  # re-insert: move to MRU position
         _registry.inc("fuser.cache_hit")
-        return fn, False
+        _ledger.record_cache(fp, "hit")
+        return fn, False, fp
     if len(_compile_cache) >= _COMPILE_CACHE_MAX:
-        _compile_cache.pop(next(iter(_compile_cache)))
+        old_key = next(iter(_compile_cache))  # LRU: least recently used
+        _compile_cache.pop(old_key)
+        _registry.inc("fuser.cache_evict")
+        _ledger.record_cache(_ledger.fingerprint(old_key), "evict")
+        _events.emit({
+            "type": "cache_evict",
+            "key": _ledger.fingerprint(old_key),
+            "capacity": _COMPILE_CACHE_MAX,
+        })
     _faults.check("compile", instrs=len(program.instrs))
     fn = jax.jit(_build_callable(program), donate_argnums=donate_key)
     _compile_cache[key] = fn
     stats["compiles"] += 1
     _registry.inc("fuser.cache_miss")
-    return fn, True
+    _ledger.record_cache(fp, "miss")
+    return fn, True, fp
 
 
 def _last_use_map(program: _Program) -> dict:
@@ -418,7 +434,8 @@ def _run_segmented(program: _Program, leaf_vals: list, donate_idx: tuple,
                    span: Optional[dict] = None,
                    seg_size: Optional[int] = None, *,
                    slot_bytes: Optional[dict] = None,
-                   max_seg_bytes: Optional[int] = None):
+                   max_seg_bytes: Optional[int] = None,
+                   rung: str = "fused"):
     """Execute an oversized program as chained jit calls of at most
     ``seg_size`` (default ``common.max_program_instrs``) instructions each.
 
@@ -447,9 +464,10 @@ def _run_segmented(program: _Program, leaf_vals: list, donate_idx: tuple,
                 continue  # caller-visible leaf not cleared for donation
             if _nbytes(vals[s]) >= DONATE_MIN_BYTES:
                 seg_donate.append(j)
-        fn, is_new = _get_compiled(seg_prog, tuple(seg_donate))
+        fn, is_new, fp = _get_compiled(seg_prog, tuple(seg_donate))
         seg_vals = [vals[s] for s in in_slots]
-        outs = _execute_compiled(fn, seg_prog, seg_vals, is_new, span=span)
+        outs = _execute_compiled(fn, seg_prog, seg_vals, is_new, span=span,
+                                 fp=fp, rung=rung, donated=len(seg_donate))
         del seg_vals
         for s in in_slots:
             if last_use.get(s, 0) < top:
@@ -478,20 +496,27 @@ def _run_chunked(program: _Program, leaf_vals, donate_idx: tuple,
         span["chunk_bytes"] = cap
     _registry.inc("fuser.chunked_runs")
     return _run_segmented(program, leaf_vals, donate_idx, span=span,
-                          slot_bytes=slot_bytes, max_seg_bytes=cap)
+                          slot_bytes=slot_bytes, max_seg_bytes=cap,
+                          rung="chunked")
 
 
 def _execute_compiled(fn, program: _Program, leaf_vals, is_new: bool,
-                      span: Optional[dict] = None):
+                      span: Optional[dict] = None, fp: Optional[str] = None,
+                      rung: str = "fused", donated: int = 0):
     """Run one compiled program with the shared observability treatment:
     RAMBA_SHOW_CODE dump on first compile, profiler TraceAnnotation at
     RAMBA_TIMING>=2 or under RAMBA_PROFILE_DIR, first-call
-    (trace+lower+XLA compile) vs steady-state timing attribution, and —
-    when ``span`` is given — a per-call child record in the flush span.
-    Used by both the monolithic and segmented flush paths so the two can
-    never drift."""
+    (trace+lower+XLA compile) vs steady-state timing attribution, a cost
+    ledger record filed under ``fp`` (with the degradation ``rung`` this
+    execution ran on), and — when ``span`` is given — a per-call child
+    record in the flush span.  Used by both the monolithic and segmented
+    flush paths so the two can never drift."""
     _faults.check("execute", instrs=len(program.instrs))
     _faults.check("oom", instrs=len(program.instrs))
+    if is_new and _ledger.cost_enabled() and fp is not None:
+        # Before execution: donated input buffers are dead afterwards, and
+        # AOT lowering wants live avals.
+        _ledger.capture_cost(fp, fn, leaf_vals)
     if is_new and common.show_code:
         import sys
 
@@ -507,6 +532,7 @@ def _execute_compiled(fn, program: _Program, leaf_vals, is_new: bool,
             print(fn.lower(*leaf_vals).as_text()[:20000], file=sys.stderr)
         except Exception:
             pass
+    bytes_in = sum(_nbytes(v) for v in leaf_vals)
     t0 = time.perf_counter()
     if common.timing_level > 1 or _profile.enabled():
         # label the dispatch in profiler traces (RAMBA_PROFILE_DIR /
@@ -516,6 +542,12 @@ def _execute_compiled(fn, program: _Program, leaf_vals, is_new: bool,
     else:
         outs = fn(*leaf_vals)
     dt = time.perf_counter() - t0
+    sync_dt = None
+    if _ledger.sync_timing():
+        # RAMBA_PERF=sync: a second, device-synchronized sample.  dt above
+        # stays the dispatch-time measurement every existing consumer sees.
+        jax.block_until_ready(outs)
+        sync_dt = time.perf_counter() - t0
     if is_new:
         # jax.jit compiles lazily: the first call pays trace+lower+XLA
         # compile.  Attribute it separately so per-program execution times
@@ -525,6 +557,13 @@ def _execute_compiled(fn, program: _Program, leaf_vals, is_new: bool,
         _timing.add_time("flush_execute", dt)
         if common.timing_level > 0:  # label hashing is off the hot path
             _timing.add_func_time(_program_label(program), dt)
+    if fp is not None:
+        _ledger.record_execute(
+            fp, _program_label(program), len(program.instrs), rung, dt,
+            is_new, bytes_in=bytes_in,
+            bytes_out=sum(_nbytes(o) for o in outs),
+            donated=donated, sync_seconds=sync_dt,
+        )
     if span is not None:
         span["calls"].append({
             "label": _program_label(program),
@@ -543,8 +582,9 @@ def _attempt_fused(program: _Program, leaf_vals, donate_key: tuple,
         and len(program.instrs) > common.max_program_instrs
     ):
         return _run_segmented(program, leaf_vals, donate_key, span=span)
-    fn, is_new = _get_compiled(program, donate_key)
-    return _execute_compiled(fn, program, leaf_vals, is_new, span=span)
+    fn, is_new, fp = _get_compiled(program, donate_key)
+    return _execute_compiled(fn, program, leaf_vals, is_new, span=span,
+                             fp=fp, rung="fused", donated=len(donate_key))
 
 
 def _run_eager(program: _Program, leaf_vals, span: Optional[dict]):
@@ -558,11 +598,18 @@ def _run_eager(program: _Program, leaf_vals, span: Optional[dict]):
     with jax.spmd_mode("allow_all"):
         outs = _build_callable(program)(*leaf_vals)
     outs = jax.block_until_ready(outs)
+    dt = time.perf_counter() - t0
+    _ledger.record_execute(
+        _ledger.fingerprint(_cache_key(program, ())),
+        _program_label(program), len(program.instrs), "eager", dt, False,
+        bytes_in=sum(_nbytes(v) for v in leaf_vals),
+        bytes_out=sum(_nbytes(o) for o in outs),
+    )
     if span is not None:
         span["calls"].append({
             "label": _program_label(program),
             "cache": "eager",
-            "seconds": round(time.perf_counter() - t0, 6),
+            "seconds": round(dt, 6),
         })
     return outs
 
@@ -597,11 +644,18 @@ def _run_host(program: _Program, leaf_vals, span: Optional[dict]):
             res.append(jax.device_put(o, NamedSharding(mesh, spec)))
         except Exception:
             res.append(o)
+    dt = time.perf_counter() - t0
+    _ledger.record_execute(
+        _ledger.fingerprint(_cache_key(program, ())),
+        _program_label(program), len(program.instrs), "host", dt, False,
+        bytes_in=sum(_nbytes(v) for v in leaf_vals),
+        bytes_out=sum(_nbytes(o) for o in res),
+    )
     if span is not None:
         span["calls"].append({
             "label": _program_label(program),
             "cache": "host",
-            "seconds": round(time.perf_counter() - t0, 6),
+            "seconds": round(dt, 6),
         })
     return tuple(res)
 
@@ -639,7 +693,7 @@ def _execute_resilient(program: _Program, leaf_vals, donate_key: tuple,
         rungs.append(
             ("split",
              lambda: _run_segmented(program, leaf_vals, (), span=span,
-                                    seg_size=half)))
+                                    seg_size=half, rung="split")))
     if len(program.instrs) > 1 or route_chunked:
         chunk_donate = donate_key if route_chunked else ()
         rungs.append(
@@ -860,6 +914,10 @@ def flush(extra: Sequence[Expr] = ()) -> list:
     span["out_bytes"] = sum(_nbytes(v) for v in outs)
     span["wall_s"] = round(time.perf_counter() - t_flush, 6)
     _events.emit(span)
+    # Slow-flush sentinel: compares this flush against the program's own
+    # rolling history and emits at most one slow_flush event (after the
+    # span, so the trace reads cause-then-verdict).
+    _ledger.observe_flush(span)
     return list(outs[len(roots):])
 
 

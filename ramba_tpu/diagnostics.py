@@ -57,11 +57,25 @@ def memory_report(top: int = 5) -> dict:
     return _memory.ledger.snapshot(top=top)
 
 
+def perf_report() -> dict:
+    """Kernel cost ledger snapshot (see observe/ledger.py): one entry per
+    compiled kernel — compile wall time, rolling execution stats
+    (count/total/min/max/p50/p95), bytes in/out, cache hit/miss/evict,
+    per-degradation-rung execution counts, XLA cost_analysis flops and
+    bytes-accessed when captured — plus per-program flush wall-time
+    windows and the slow-flush sentinel tally.  This is the capture
+    format ``scripts/perf_diff.py`` compares."""
+    from ramba_tpu.observe import ledger as _ledger
+
+    return _ledger.snapshot()
+
+
 def snapshot() -> dict:
     """Everything, JSON-serializable: registry stores + the event ring."""
     snap = _registry.snapshot()
     snap["events"] = list(_events.ring)
     snap["memory"] = memory_report()
+    snap["perf"] = perf_report()
     return snap
 
 
@@ -112,6 +126,32 @@ def report(file=None) -> None:
                 f" {row['dtype']:<10s} {state}",
                 file=file,
             )
+    perf = perf_report()
+    if perf["kernels"]:
+        rows = sorted(
+            perf["kernels"].items(),
+            key=lambda kv: kv[1]["exec"]["total_s"] + kv[1]["compile_s"],
+            reverse=True,
+        )[:8]
+        print(f"-- kernels (top {len(rows)} of {len(perf['kernels'])}"
+              f" by wall time, mode={perf['mode']}) --", file=file)
+        for fp, k in rows:
+            ex = k["exec"]
+            rungs = ",".join(f"{r}:{n}" for r, n in sorted(k["rungs"].items()))
+            line = (
+                f"  {fp} {k['label']:<18s} x{ex['count']:<5d}"
+                f" p50={ex['p50_s'] or 0:.4f}s p95={ex['p95_s'] or 0:.4f}s"
+                f" compile={k['compile_s']:.4f}s"
+                f" hit/miss/evict={k['cache']['hits']}/{k['cache']['misses']}"
+                f"/{k['cache']['evicts']}"
+            )
+            if rungs:
+                line += f" rungs={rungs}"
+            if k.get("flops") is not None:
+                line += f" flops={k['flops']:.3g}"
+            print(line, file=file)
+        if perf["slow_flushes"]:
+            print(f"  slow flushes: {perf['slow_flushes']}", file=file)
     fl = last_flushes()
     if fl:
         print(f"-- last {len(fl)} flush span(s) --", file=file)
@@ -136,6 +176,10 @@ def dump(path: str) -> str:
 
 
 def reset() -> None:
-    """Clear counters, timers, and the event ring (tests/benchmarks)."""
+    """Clear counters, timers, the event ring, and the kernel cost ledger
+    (tests/benchmarks)."""
+    from ramba_tpu.observe import ledger as _ledger
+
     _registry.reset()
     _events.ring.clear()
+    _ledger.reset()

@@ -5,8 +5,9 @@ The reference ships opt-in wall-clock timers and DAG debug dumps
 rebuild's production posture on top of those seeds: every flush emits a
 structured span (``events``), every subsystem increments named counters in
 one registry (``registry``), hardware bring-up lands health records in the
-same stream (``health``), and ``RAMBA_PROFILE_DIR`` lines the whole thing
-up with jax.profiler/Perfetto traces (``profile``).
+same stream (``health``), every compiled kernel accumulates a cost ledger
+entry feeding a slow-flush sentinel (``ledger``), and ``RAMBA_PROFILE_DIR``
+lines the whole thing up with jax.profiler/Perfetto traces (``profile``).
 
 Environment variables:
 
@@ -16,8 +17,14 @@ Environment variables:
   always on, file output only when RAMBA_TRACE is set).
 * ``RAMBA_PROFILE_DIR=<dir>`` — capture a jax.profiler trace of every
   flush, annotated by program label.
+* ``RAMBA_PERF`` — ``1`` adds XLA cost_analysis capture per kernel and the
+  ``kernels`` section in bench.py; ``sync`` also records synchronized
+  execution timing.  The ledger itself is always on.
+* ``RAMBA_SLOW_FLUSH_FACTOR`` / ``RAMBA_SLOW_FLUSH_MIN_SAMPLES`` /
+  ``RAMBA_PERF_WINDOW`` — slow-flush sentinel tuning (see ``ledger``).
 
-Public read API lives in ``ramba_tpu.diagnostics``.
+Public read API lives in ``ramba_tpu.diagnostics`` (``perf_report()`` for
+the ledger).
 """
 
-from ramba_tpu.observe import events, health, profile, registry  # noqa: F401
+from ramba_tpu.observe import events, health, ledger, profile, registry  # noqa: F401

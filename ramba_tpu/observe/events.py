@@ -30,7 +30,7 @@ ring: "collections.deque" = collections.deque(maxlen=_RING_MAX)
 _trace_path: Optional[str] = os.environ.get("RAMBA_TRACE") or None
 _trace_file = None
 _seq = 0
-_rank: Optional[int] = None
+_rank: Optional[tuple] = None
 
 
 def trace_enabled() -> bool:
@@ -45,18 +45,57 @@ def configure(path: Optional[str]) -> None:
     _trace_path = path or None
 
 
+def _probe_rank():
+    """``(rank, nprocs, authoritative)``.  Authoritative only once the
+    process topology can no longer change: a distributed client exists
+    (multi-controller bring-up completed) or a backend has initialized
+    (after which ``jax.process_count()`` is frozen).  Before either, we
+    report single-process semantics WITHOUT initializing anything —
+    calling ``jax.process_count()`` here would force single-process
+    backend bring-up and poison a later ``distributed.initialize``."""
+    try:
+        import jax
+
+        try:
+            from jax._src import distributed as _jdist
+
+            if getattr(_jdist.global_state, "client", None) is not None:
+                return jax.process_index(), jax.process_count(), True
+        except Exception:
+            pass
+        try:
+            from jax._src import xla_bridge as _xb
+
+            if not _xb.backends_are_initialized():
+                return 0, 1, False
+        except Exception:
+            pass
+        return jax.process_index(), jax.process_count(), True
+    except Exception:  # backend unavailable: single-process semantics
+        return 0, 1, False
+
+
 def _rank_info():
-    """(rank, nprocs) — requires an initialized jax backend, so it is read
-    lazily at first emit (always after bring-up) and cached."""
+    """(rank, nprocs) — cached only once authoritative (see _probe_rank),
+    so an emit that happens BEFORE distributed bring-up cannot freeze the
+    wrong identity onto every later event of a multi-controller run."""
     global _rank
     if _rank is None:
-        try:
-            import jax
-
-            _rank = (jax.process_index(), jax.process_count())
-        except Exception:  # backend unavailable: single-process semantics
-            _rank = (0, 1)
+        r, n, authoritative = _probe_rank()
+        if not authoritative:
+            return (r, n)
+        _rank = (r, n)
     return _rank
+
+
+def invalidate_rank() -> None:
+    """Drop the cached (rank, nprocs) AND any trace sink opened under the
+    stale identity — ``distributed.initialize`` calls this the moment the
+    process group forms, so the next emit re-probes and reopens the JSONL
+    file under the correct ``.rank<i>`` name."""
+    global _rank
+    _rank = None
+    close()
 
 
 def _file():

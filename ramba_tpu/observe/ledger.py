@@ -1,0 +1,429 @@
+"""Per-compiled-kernel cost ledger + slow-flush sentinel (`ramba-perf`).
+
+The flush span stream (observe/events.py) records *that* a flush happened
+and what it cost in aggregate; this module attributes cost to the unit
+users actually pay for — the compiled kernel — and guards each kernel's
+trajectory against its own history:
+
+* **Ledger.**  Every compile-cache interaction and every execution in
+  ``core/fuser.py`` (all rungs: fused/split/chunked/eager/host) lands in
+  one entry per kernel, keyed by a *stable fingerprint* of the fuser's
+  full ``_cache_key`` (structure + donation mask + semantic regime).
+  Entries carry compile wall time, rolling execution stats
+  (count/total/min/max/p50/p95 over the last ``RAMBA_PERF_WINDOW``
+  samples), bytes in/out, cache hit/miss/evict counts, per-rung
+  execution counts, and — when XLA's AOT ``cost_analysis()`` is
+  available and ``RAMBA_PERF`` is on — analytic flops / bytes-accessed.
+  Accumulation is ALWAYS on: it is a few dict operations per dispatch,
+  cheap against the dispatch itself.
+* **Timing regimes.**  Execution samples are dispatch-time by default
+  (the async-dispatch wall the rest of the span machinery already
+  measures, so the hot path is unperturbed).  ``RAMBA_PERF=sync``
+  additionally records ``block_until_ready``-synchronized samples in a
+  separate rolling window — device time, at the cost of serializing
+  dispatch.
+* **Slow-flush sentinel.**  Each flush's wall time feeds a rolling
+  window per flush program; once a program has
+  ``RAMBA_SLOW_FLUSH_MIN_SAMPLES`` samples, a flush slower than
+  ``RAMBA_SLOW_FLUSH_FACTOR`` x the rolling p50 emits ONE ``slow_flush``
+  event (kernel label, rung, bytes, compile-vs-execute attribution) on
+  the observability stream.  Deterministic trigger for tests: the
+  ``delay:ms=<n>`` fault mode (resilience/faults.py).
+
+Environment:
+
+* ``RAMBA_PERF`` — unset/0: ledger on, cost_analysis off (default);
+  ``1``/``on``: + capture XLA cost_analysis per new kernel and emit the
+  ``kernels`` section in bench.py; ``sync``: all of that + synchronized
+  execution timing.
+* ``RAMBA_SLOW_FLUSH_FACTOR`` — sentinel threshold multiplier (default
+  4.0; <= 0 disables the sentinel).
+* ``RAMBA_SLOW_FLUSH_MIN_SAMPLES`` — samples before the sentinel may
+  fire for a program (default 5).
+* ``RAMBA_PERF_WINDOW`` — rolling-window length (default 64).
+
+Read APIs: ``snapshot()`` here, ``ramba_tpu.diagnostics.perf_report()``,
+the ``kernels`` section of ``bench.py``'s JSON line, and offline
+``scripts/perf_diff.py`` which compares two captures and fails CI on
+per-kernel regressions.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from collections import deque
+from typing import Optional
+
+from ramba_tpu.observe import events as _events
+from ramba_tpu.observe import registry as _registry
+
+
+# ---------------------------------------------------------------------------
+# configuration (re-readable for tests via reconfigure())
+# ---------------------------------------------------------------------------
+
+
+def _parse_mode(v: Optional[str]) -> str:
+    if not v or v in ("0", "off", "false", "no"):
+        return ""
+    if v.strip().lower() == "sync":
+        return "sync"
+    return "on"
+
+
+_mode = ""
+_slow_factor = 4.0
+_min_samples = 5
+_window = 64
+
+
+def reconfigure(*, mode: Optional[str] = None,
+                factor: Optional[float] = None,
+                min_samples: Optional[int] = None,
+                window: Optional[int] = None) -> None:
+    """Reload configuration from the environment, with explicit keyword
+    overrides (tests).  Existing rolling windows keep their old length;
+    only windows created after a ``window`` change use the new one."""
+    global _mode, _slow_factor, _min_samples, _window
+    _mode = _parse_mode(os.environ.get("RAMBA_PERF")) if mode is None \
+        else _parse_mode(mode)
+    try:
+        _slow_factor = float(
+            os.environ.get("RAMBA_SLOW_FLUSH_FACTOR", "4.0") or 4.0
+        ) if factor is None else float(factor)
+    except ValueError:
+        _slow_factor = 4.0
+    try:
+        _min_samples = int(
+            os.environ.get("RAMBA_SLOW_FLUSH_MIN_SAMPLES", "5") or 5
+        ) if min_samples is None else int(min_samples)
+    except ValueError:
+        _min_samples = 5
+    try:
+        _window = max(4, int(
+            os.environ.get("RAMBA_PERF_WINDOW", "64") or 64
+        ) if window is None else int(window))
+    except ValueError:
+        _window = 64
+
+
+def mode() -> str:
+    return _mode
+
+
+def sync_timing() -> bool:
+    return _mode == "sync"
+
+
+def cost_enabled() -> bool:
+    return _mode in ("on", "sync")
+
+
+# ---------------------------------------------------------------------------
+# rolling statistics
+# ---------------------------------------------------------------------------
+
+
+class _Rolling:
+    """Count/total/min/max over the full history + quantiles over a
+    bounded window of the most recent samples."""
+
+    __slots__ = ("count", "total", "min", "max", "window")
+
+    def __init__(self, window: Optional[int] = None):
+        self.count = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+        self.window: "deque[float]" = deque(maxlen=window or _window)
+
+    def add(self, s: float) -> None:
+        self.count += 1
+        self.total += s
+        if self.min is None or s < self.min:
+            self.min = s
+        if self.max is None or s > self.max:
+            self.max = s
+        self.window.append(s)
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Nearest-rank quantile over the rolling window (None when
+        empty)."""
+        if not self.window:
+            return None
+        srt = sorted(self.window)
+        idx = max(0, min(len(srt) - 1,
+                         int(-(-q * len(srt) // 1)) - 1))  # ceil - 1
+        return srt[idx]
+
+    def summary(self) -> dict:
+        out = {
+            "count": self.count,
+            "total_s": round(self.total, 6),
+            "min_s": round(self.min, 6) if self.min is not None else None,
+            "max_s": round(self.max, 6) if self.max is not None else None,
+        }
+        p50, p95 = self.quantile(0.50), self.quantile(0.95)
+        out["p50_s"] = round(p50, 6) if p50 is not None else None
+        out["p95_s"] = round(p95, 6) if p95 is not None else None
+        return out
+
+
+# ---------------------------------------------------------------------------
+# stable kernel fingerprints
+# ---------------------------------------------------------------------------
+
+
+def _token(x) -> str:
+    """Canonical serialization of one cache-key element: stable across
+    processes (no ``id()``-bearing reprs), so two SPMD ranks — or two
+    runs being diffed by scripts/perf_diff.py — fingerprint the same
+    program identically.  Plain values serialize by repr; anything that
+    could embed a memory address (closures in statics, array objects)
+    degrades to its type/qualname."""
+    if x is None or isinstance(x, (bool, int, float, str, bytes)):
+        return repr(x)
+    if isinstance(x, (tuple, list)):
+        return "(" + ",".join(_token(i) for i in x) + ")"
+    if isinstance(x, dict):
+        items = sorted(x.items(), key=lambda kv: repr(kv[0]))
+        return "{" + ",".join(_token(k) + ":" + _token(v)
+                              for k, v in items) + "}"
+    name = getattr(x, "__qualname__", None) or getattr(x, "__name__", None)
+    if name:
+        return f"<{type(x).__name__}:{name}>"
+    return f"<{type(x).__module__}.{type(x).__name__}>"
+
+
+_fp_memo: dict = {}
+_FP_MEMO_MAX = 4096
+
+
+def fingerprint(cache_key) -> str:
+    """12-hex stable fingerprint of a fuser ``_cache_key`` tuple.
+    Memoized on the (hashable) key tuple itself so the hot path pays one
+    dict lookup per flush, not a re-serialization."""
+    try:
+        fp = _fp_memo.get(cache_key)
+    except TypeError:  # unhashable element snuck in: serialize every time
+        return hashlib.sha256(_token(cache_key).encode()).hexdigest()[:12]
+    if fp is None:
+        fp = hashlib.sha256(_token(cache_key).encode()).hexdigest()[:12]
+        if len(_fp_memo) >= _FP_MEMO_MAX:
+            _fp_memo.clear()
+        _fp_memo[cache_key] = fp
+    return fp
+
+
+# ---------------------------------------------------------------------------
+# the ledger proper
+# ---------------------------------------------------------------------------
+
+
+class KernelEntry:
+    """All accumulated cost knowledge about one compiled kernel."""
+
+    __slots__ = (
+        "label", "instrs", "donated", "compiles", "compile_s",
+        "exec", "sync", "bytes_in", "bytes_out",
+        "hits", "misses", "evicts", "rungs",
+        "flops", "bytes_accessed", "_cost_tried",
+    )
+
+    def __init__(self, label: str = "?", instrs: int = 0, donated: int = 0):
+        self.label = label
+        self.instrs = instrs
+        self.donated = donated
+        self.compiles = 0
+        self.compile_s = 0.0
+        self.exec = _Rolling()
+        self.sync: Optional[_Rolling] = None
+        self.bytes_in = 0
+        self.bytes_out = 0
+        self.hits = 0
+        self.misses = 0
+        self.evicts = 0
+        self.rungs: dict = {}
+        self.flops: Optional[float] = None
+        self.bytes_accessed: Optional[float] = None
+        self._cost_tried = False
+
+    def summary(self) -> dict:
+        out = {
+            "label": self.label,
+            "instrs": self.instrs,
+            "donated": self.donated,
+            "compiles": self.compiles,
+            "compile_s": round(self.compile_s, 6),
+            "exec": self.exec.summary(),
+            "bytes_in": self.bytes_in,
+            "bytes_out": self.bytes_out,
+            "cache": {"hits": self.hits, "misses": self.misses,
+                      "evicts": self.evicts},
+            "rungs": dict(self.rungs),
+        }
+        if self.sync is not None:
+            out["sync"] = self.sync.summary()
+        if self.flops is not None:
+            out["flops"] = self.flops
+        if self.bytes_accessed is not None:
+            out["bytes_accessed"] = self.bytes_accessed
+        return out
+
+
+_kernels: "dict[str, KernelEntry]" = {}
+
+# flush-program label -> rolling wall-time window (sentinel state)
+_flush_walls: "dict[str, _Rolling]" = {}
+_slow_flushes = 0
+
+
+def _entry(fp: str, label: Optional[str] = None, instrs: int = 0,
+           donated: int = 0) -> KernelEntry:
+    e = _kernels.get(fp)
+    if e is None:
+        e = KernelEntry(label or "?", instrs, donated)
+        _kernels[fp] = e
+    elif label is not None and e.label == "?":
+        e.label = label
+    return e
+
+
+def record_cache(fp: str, kind: str, label: Optional[str] = None) -> None:
+    """One compile-cache interaction: ``kind`` in hit|miss|evict."""
+    e = _entry(fp, label)
+    if kind == "hit":
+        e.hits += 1
+    elif kind == "miss":
+        e.misses += 1
+    elif kind == "evict":
+        e.evicts += 1
+
+
+def record_execute(fp: str, label: str, instrs: int, rung: str,
+                   seconds: float, is_new: bool,
+                   bytes_in: int = 0, bytes_out: int = 0,
+                   donated: int = 0,
+                   sync_seconds: Optional[float] = None) -> None:
+    """One execution of a compiled (or interpreted) kernel.
+
+    First calls (``is_new``) pay jit trace + lower + XLA compile and are
+    accounted as compile wall time, NOT as execution samples — mixing
+    them in would poison the steady-state percentiles the sentinel and
+    perf_diff compare against."""
+    e = _entry(fp, label, instrs, donated)
+    e.instrs = instrs or e.instrs
+    e.donated = max(e.donated, donated)
+    e.bytes_in += int(bytes_in)
+    e.bytes_out += int(bytes_out)
+    e.rungs[rung] = e.rungs.get(rung, 0) + 1
+    if is_new:
+        e.compiles += 1
+        e.compile_s += seconds
+    else:
+        e.exec.add(seconds)
+        if sync_seconds is not None:
+            if e.sync is None:
+                e.sync = _Rolling()
+            e.sync.add(sync_seconds)
+
+
+def capture_cost(fp: str, fn, leaf_vals) -> None:
+    """Attach XLA AOT ``cost_analysis()`` flops / bytes-accessed to the
+    kernel entry, once, when ``RAMBA_PERF`` is on.  The AOT
+    lower+compile is a second compilation of the same program — strictly
+    opt-in and once per kernel; any failure (backend without
+    cost_analysis, extended dtypes) just leaves the fields absent."""
+    if not cost_enabled():
+        return
+    e = _entry(fp)
+    if e._cost_tried:
+        return
+    e._cost_tried = True
+    try:
+        compiled = fn.lower(*leaf_vals).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if not ca:
+            return
+        flops = ca.get("flops")
+        if flops is not None:
+            e.flops = float(flops)
+        ba = ca.get("bytes accessed")
+        if ba is not None:
+            e.bytes_accessed = float(ba)
+    except Exception:
+        pass
+
+
+def observe_flush(span: dict) -> Optional[dict]:
+    """Feed one finished flush span into the sentinel.  Emits (and
+    returns) at most ONE ``slow_flush`` event when this flush's wall
+    time exceeds ``RAMBA_SLOW_FLUSH_FACTOR`` x the program's rolling p50
+    — compared against history BEFORE this sample joins the window, so
+    one slow flush cannot mask the next."""
+    global _slow_flushes
+    label = span.get("label", "?")
+    wall = float(span.get("wall_s", 0.0) or 0.0)
+    win = _flush_walls.get(label)
+    if win is None:
+        win = _flush_walls[label] = _Rolling()
+    fired = None
+    if _slow_factor > 0 and win.count >= _min_samples:
+        p50 = win.quantile(0.50)
+        if p50 and wall > _slow_factor * p50:
+            _slow_flushes += 1
+            _registry.inc("perf.slow_flush")
+            fired = _events.emit({
+                "type": "slow_flush",
+                "label": label,
+                "rung": span.get("degraded", "fused"),
+                "wall_s": round(wall, 6),
+                "p50_s": round(p50, 6),
+                "slowdown": round(wall / p50, 2),
+                "factor": _slow_factor,
+                "samples": win.count,
+                "instrs": span.get("instrs"),
+                "bytes_in": span.get("leaf_bytes"),
+                "bytes_out": span.get("out_bytes"),
+                "compile_s": span.get("compile_s"),
+                "execute_s": span.get("execute_s"),
+                "cache": span.get("cache"),
+            })
+    win.add(wall)
+    return fired
+
+
+def snapshot() -> dict:
+    """JSON-serializable ledger dump — the payload behind
+    ``diagnostics.perf_report()``, bench.py's ``kernels`` section, and
+    ``scripts/perf_diff.py`` captures."""
+    return {
+        "mode": _mode or "off",
+        "slow_flush_factor": _slow_factor,
+        "slow_flush_min_samples": _min_samples,
+        "window": _window,
+        "slow_flushes": _slow_flushes,
+        "kernels": {fp: e.summary() for fp, e in _kernels.items()},
+        "flushes": {label: w.summary() for label, w in _flush_walls.items()},
+    }
+
+
+def kernel_keys() -> list:
+    """Sorted kernel fingerprints — SPMD ranks running in lockstep must
+    report identical sets (asserted by two_process_suite --perf-leg)."""
+    return sorted(_kernels)
+
+
+def reset() -> None:
+    """Drop all accumulated state (tests/benchmarks)."""
+    global _slow_flushes
+    _kernels.clear()
+    _flush_walls.clear()
+    _fp_memo.clear()
+    _slow_flushes = 0
+
+
+reconfigure()

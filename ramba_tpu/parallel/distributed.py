@@ -139,6 +139,13 @@ def initialize(
         )
         raise
     _initialized = True
+    # The process group just formed: any (rank, nprocs) the event stream
+    # cached from a pre-bring-up emit is stale.  Re-probe before the health
+    # record below so IT already carries the authoritative rank (and lands
+    # in the right per-rank trace file).
+    from ramba_tpu.observe import events as _events
+
+    _events.invalidate_rank()
     _health.record(
         outcome="ok", source="distributed_init",
         init_seconds=time.perf_counter() - t0,

@@ -17,6 +17,11 @@ specs.  Modes:
   from ``RAMBA_FAULTS_SEED`` + site + call number, so the fire pattern
   is a pure function of the seed.  Under multi-controller SPMD every
   rank sees the same pattern and the ranks stay in collective lockstep.
+* ``delay:ms=<n>`` sleep ``n`` milliseconds at every check of the site
+  and then continue — no exception.  This simulates slowness rather
+  than failure (a deterministic trigger for the slow-flush sentinel in
+  observe/ledger.py): ``RAMBA_FAULTS='execute:delay:ms=200'`` makes
+  every flush's execute step 200 ms slower without perturbing results.
 
 Sites are free-form strings; the ones wired into the codebase are
 ``compile``, ``execute``, ``oom``, ``eager``, ``host``, ``rewrite``,
@@ -88,17 +93,21 @@ class InjectedFatalFault(InjectedFault):
 
 
 class _Spec:
-    __slots__ = ("site", "mode", "kind", "n", "p", "nbytes", "calls", "fired")
+    __slots__ = ("site", "mode", "kind", "n", "p", "nbytes", "delay_ms",
+                 "calls", "fired")
 
     def __init__(self, site: str, mode: str, kind: str,
                  n: Optional[int] = None, p: Optional[float] = None,
-                 nbytes: Optional[int] = None):
+                 nbytes: Optional[int] = None,
+                 delay_ms: Optional[float] = None):
         self.site = site
-        self.mode = mode      # "once" | "always" | "count" | "after" | "prob"
-        self.kind = kind      # "transient" | "oom" | "fatal"
+        # "once" | "always" | "count" | "after" | "prob" | "delay"
+        self.mode = mode
+        self.kind = kind      # "transient" | "oom" | "fatal" | "delay"
         self.n = n
         self.p = p
         self.nbytes = nbytes  # simulated allocation size for oom kinds
+        self.delay_ms = delay_ms  # sleep length for delay mode
         self.calls = 0
         self.fired = 0
 
@@ -116,9 +125,22 @@ def _parse_one(chunk: str) -> _Spec:
     mode = parts[1].strip()
     kind = ""
     nbytes: Optional[int] = None
+    delay_ms: Optional[float] = None
     for extra in parts[2:]:
         extra = extra.strip().lower()
-        if extra.startswith("bytes="):
+        if extra.startswith("ms="):
+            if delay_ms is not None:
+                raise ValueError(
+                    f"bad RAMBA_FAULTS spec {chunk!r}: duplicate ms=")
+            try:
+                delay_ms = float(extra[len("ms="):])
+            except ValueError:
+                raise ValueError(
+                    f"bad RAMBA_FAULTS ms= payload in {chunk!r}") from None
+            if delay_ms < 0:
+                raise ValueError(
+                    f"negative RAMBA_FAULTS ms= payload in {chunk!r}")
+        elif extra.startswith("bytes="):
             if nbytes is not None:
                 raise ValueError(
                     f"bad RAMBA_FAULTS spec {chunk!r}: duplicate bytes=")
@@ -134,6 +156,18 @@ def _parse_one(chunk: str) -> _Spec:
                 f"bad RAMBA_FAULTS spec {chunk!r}: too many fields")
     if kind not in ("", "oom", "fatal", "transient"):
         raise ValueError(f"bad RAMBA_FAULTS kind {kind!r} in {chunk!r}")
+    if mode == "delay":
+        # slowness, not failure: fires every check, sleeps, never raises
+        if kind:
+            raise ValueError(
+                f"bad RAMBA_FAULTS spec {chunk!r}: delay takes no kind")
+        if delay_ms is None:
+            raise ValueError(
+                f"bad RAMBA_FAULTS spec {chunk!r}: delay needs ms=<n>")
+        return _Spec(site, "delay", "delay", delay_ms=delay_ms)
+    if delay_ms is not None:
+        raise ValueError(
+            f"bad RAMBA_FAULTS spec {chunk!r}: ms= only valid with delay")
     if not kind:
         kind = "oom" if site == "oom" else "transient"
     if mode == "once":
@@ -214,7 +248,7 @@ def stats() -> Dict[str, dict]:
 def _should_fire(sp: _Spec) -> bool:
     if sp.mode == "once":
         return sp.fired == 0
-    if sp.mode == "always":
+    if sp.mode in ("always", "delay"):
         return True
     if sp.mode == "count":
         return sp.fired < (sp.n or 0)
@@ -246,14 +280,22 @@ def check(site: str, **ctx) -> None:
         kind = sp.kind
         mode = sp.mode
         nbytes = sp.nbytes
+        delay_ms = sp.delay_ms
     _registry.inc("resilience.fault_injected")
     _registry.inc(f"resilience.fault_injected.{site}")
     ev = {"type": "fault", "site": site, "call": call, "mode": mode,
           "kind": kind}
     if nbytes is not None:
         ev["bytes"] = nbytes
+    if delay_ms is not None:
+        ev["ms"] = delay_ms
     ev.update(ctx)
     _events.emit(ev)
+    if kind == "delay":
+        import time
+
+        time.sleep((delay_ms or 0.0) / 1000.0)
+        return
     if kind == "oom":
         raise InjectedResourceExhausted(site, call, nbytes)
     if kind == "fatal":

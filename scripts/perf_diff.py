@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""Compare two perf captures and fail on regressions (`ramba-perf`).
+
+Makes the ``BENCH_r*.json`` trajectory machine-checkable: instead of
+eyeballing raw stdout tails across TPU windows, diff two captures and
+exit nonzero when any kernel (or headline metric) regressed past a
+threshold::
+
+    RAMBA_PERF=1 python bench.py > new.json
+    python scripts/perf_diff.py BENCH_r07.json new.json --threshold 1.5
+
+Accepted capture formats (auto-detected, mixable):
+
+* ``bench.py`` JSON output with a ``kernels`` section (RAMBA_PERF=1),
+* ``diagnostics.dump()`` snapshots (``perf.kernels``),
+* a raw ``diagnostics.perf_report()`` / ``observe.ledger.snapshot()``
+  dump (top-level ``kernels``).
+
+Per-kernel comparison uses steady-state execution p50 (falling back to
+mean when the window is too small), keyed by the ledger's stable kernel
+fingerprint — identical programs fingerprint identically across runs and
+ranks, so old/new line up without name matching.  Headline bench scalars
+(chain wall, stencil MFLOPS, ...) are compared direction-aware when both
+captures carry them.
+
+Exit status: 0 no regressions; 1 regressions found; 2 usage/input error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# headline bench.py scalars worth gating on, and which direction is good
+_METRIC_DIRECTION = {
+    "value": "lower",               # chain wall-clock seconds
+    "dispatch_floor_ms": "lower",
+    "stencil_mflops": "higher",
+    "stencil_iter_mflops": "higher",
+    "axpy_gb_per_s": "higher",
+    "axpy_gb_per_s_net": "higher",
+    "bcast_gelems_per_s": "higher",
+    "hbm_gb_per_s": "higher",
+    "hbm_gb_per_s_net": "higher",
+    "matmul_tflops": "higher",
+}
+
+
+def load_capture(path: str) -> dict:
+    """Load one capture file; returns ``{"kernels": {...}, "metrics":
+    {...}}`` (either may be empty)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError:
+        # bench stdout may carry non-JSON warm-up lines; take the last
+        # parseable line (bench.py prints exactly one JSON object line)
+        obj = None
+        for line in reversed(text.splitlines()):
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                obj = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+        if obj is None:
+            raise ValueError(f"{path}: no JSON object found")
+    if not isinstance(obj, dict):
+        raise ValueError(f"{path}: expected a JSON object")
+    kernels = obj.get("kernels")
+    if kernels is None:
+        kernels = obj.get("perf", {}).get("kernels", {})
+    metrics = {
+        k: obj[k] for k in _METRIC_DIRECTION
+        if isinstance(obj.get(k), (int, float))
+    }
+    return {"kernels": kernels or {}, "metrics": metrics}
+
+
+def _exec_stat(entry: dict) -> tuple:
+    """(representative steady-state seconds, sample count) for a kernel
+    entry — p50 when present, else mean over the full history."""
+    ex = entry.get("exec") or {}
+    count = int(ex.get("count") or 0)
+    p50 = ex.get("p50_s")
+    if p50 is not None:
+        return float(p50), count
+    total = ex.get("total_s")
+    if count and total is not None:
+        return float(total) / count, count
+    return 0.0, count
+
+
+def diff(old: dict, new: dict, threshold: float,
+         min_samples: int) -> tuple:
+    """Returns (regressions, improvements, skipped) row lists."""
+    regressions, improvements, skipped = [], [], []
+    shared = sorted(set(old["kernels"]) & set(new["kernels"]))
+    for fp in shared:
+        o, n = old["kernels"][fp], new["kernels"][fp]
+        os_, oc = _exec_stat(o)
+        ns_, nc = _exec_stat(n)
+        label = n.get("label") or o.get("label") or "?"
+        if oc < min_samples or nc < min_samples or os_ <= 0:
+            skipped.append((fp, label, f"samples {oc}/{nc}"))
+            continue
+        ratio = ns_ / os_
+        row = (fp, label, os_, ns_, ratio)
+        if ratio > threshold:
+            regressions.append(row)
+        elif ratio < 1.0 / threshold:
+            improvements.append(row)
+    for key, direction in _METRIC_DIRECTION.items():
+        ov, nv = old["metrics"].get(key), new["metrics"].get(key)
+        if ov is None or nv is None or ov <= 0 or nv <= 0:
+            continue
+        ratio = (nv / ov) if direction == "lower" else (ov / nv)
+        row = (key, f"metric:{direction}", ov, nv, ratio)
+        if ratio > threshold:
+            regressions.append(row)
+        elif ratio < 1.0 / threshold:
+            improvements.append(row)
+    return regressions, improvements, skipped
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff two ramba perf captures; exit 1 on regression"
+    )
+    ap.add_argument("old", help="baseline capture (bench JSON / perf dump)")
+    ap.add_argument("new", help="candidate capture")
+    ap.add_argument("--threshold", type=float, default=1.5,
+                    help="regression ratio per kernel/metric (default 1.5)")
+    ap.add_argument("--min-samples", type=int, default=3,
+                    help="skip kernels with fewer exec samples (default 3)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the verdict as one JSON object")
+    args = ap.parse_args(argv)
+    if args.threshold <= 1.0:
+        print("perf_diff: --threshold must be > 1.0", file=sys.stderr)
+        return 2
+    try:
+        old = load_capture(args.old)
+        new = load_capture(args.new)
+    except (OSError, ValueError) as e:
+        print(f"perf_diff: {e}", file=sys.stderr)
+        return 2
+    if not old["kernels"] and not old["metrics"]:
+        print(f"perf_diff: {args.old}: no kernels/metrics section "
+              "(run with RAMBA_PERF=1?)", file=sys.stderr)
+        return 2
+    regressions, improvements, skipped = diff(
+        old, new, args.threshold, args.min_samples
+    )
+    shared = len(set(old["kernels"]) & set(new["kernels"]))
+    only_old = len(set(old["kernels"]) - set(new["kernels"]))
+    only_new = len(set(new["kernels"]) - set(old["kernels"]))
+    if args.json:
+        print(json.dumps({
+            "threshold": args.threshold,
+            "shared_kernels": shared,
+            "only_old": only_old, "only_new": only_new,
+            "regressions": [
+                {"key": k, "label": lb, "old": o, "new": n,
+                 "ratio": round(r, 3)}
+                for k, lb, o, n, r in regressions
+            ],
+            "improvements": [
+                {"key": k, "label": lb, "old": o, "new": n,
+                 "ratio": round(r, 3)}
+                for k, lb, o, n, r in improvements
+            ],
+            "skipped": len(skipped),
+            "verdict": "regressed" if regressions else "ok",
+        }))
+    else:
+        print(f"perf_diff: {shared} shared kernel(s), "
+              f"{only_old} only in old, {only_new} only in new, "
+              f"{len(skipped)} skipped (too few samples)")
+        for k, lb, o, n, r in regressions:
+            print(f"  REGRESSION {k} {lb}: {o:.6g} -> {n:.6g} "
+                  f"({r:.2f}x, threshold {args.threshold}x)")
+        for k, lb, o, n, r in improvements:
+            print(f"  improved   {k} {lb}: {o:.6g} -> {n:.6g} "
+                  f"({1 / r:.2f}x faster)")
+        print(f"perf_diff verdict: "
+              f"{'REGRESSED' if regressions else 'ok'} "
+              f"({len(regressions)} regression(s), "
+              f"{len(improvements)} improvement(s))")
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

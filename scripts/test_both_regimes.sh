@@ -14,3 +14,5 @@ echo "=== leg 4: 2-process fault injection (RAMBA_FAULTS=compile:once) ==="
 python scripts/two_process_suite.py --fault-leg
 echo "=== leg 5: 2-process memory governor (tiny RAMBA_HBM_BUDGET) ==="
 python scripts/two_process_suite.py --memory-leg
+echo "=== leg 6: 2-process kernel cost ledger (RAMBA_PERF=1) ==="
+python scripts/two_process_suite.py --perf-leg

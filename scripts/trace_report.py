@@ -19,8 +19,15 @@ Prints, per input:
     last),
   * the memory timeline (admission checks, watermark crossings, spills,
     restores, oom evictions) with a peak-live column in the flush
-    totals, and
+    totals,
+  * slow_flush sentinel events (observe/ledger.py), and
   * the top programs by cumulative wall time.
+
+``--merge-ranks`` switches to a cross-rank view: per-rank files are
+aligned by their distributed bring-up anchor (clock skew subtracted),
+interleaved into one timeline, and the per-rank flush streams are
+compared in lockstep order to flag rank divergence (e.g. one rank
+degraded to ``chunked`` while another stayed ``fused``).
 """
 
 from __future__ import annotations
@@ -81,6 +88,7 @@ def report(path: str, events: list, top: int = 10, file=None) -> None:
     _degradation_timeline(events, file=file)
     _memory_timeline(events, file=file)
     _findings_summary(events, file=file)
+    _slow_flush_summary(events, file=file)
 
     flushes = [e for e in events if e.get("type") == "flush"]
     if not flushes:
@@ -163,6 +171,29 @@ def _findings_summary(events: list, file=None) -> None:
         per.items(), key=lambda kv: (sev_rank.get(kv[0][1], 3), kv[0][0])
     ):
         print(f"  {rule:<20s} {sev:<9s} {n:>5d}  {sample}", file=file)
+
+
+def _slow_flush_summary(events: list, file=None, cap: int = 20) -> None:
+    """slow_flush sentinel events (observe/ledger.py): flushes that blew
+    past RAMBA_SLOW_FLUSH_FACTOR x their program's rolling p50, with the
+    rung they ran on and compile-vs-execute attribution."""
+    file = file or sys.stdout
+    slow = [e for e in events if e.get("type") == "slow_flush"]
+    if not slow:
+        return
+    print(f"slow flushes ({len(slow)}):", file=file)
+    for e in slow[:cap]:
+        print(
+            f"  {e.get('label', '?'):<18s} rung={e.get('rung', '?'):<8s}"
+            f" wall={e.get('wall_s', 0):.4f}s"
+            f" p50={e.get('p50_s', 0):.4f}s x{e.get('slowdown', 0)}"
+            f" compile={e.get('compile_s', 0)}s"
+            f" execute={e.get('execute_s', 0)}s"
+            f" cache={e.get('cache', '?')}",
+            file=file,
+        )
+    if len(slow) > cap:
+        print(f"  ... and {len(slow) - cap} more", file=file)
 
 
 def _degradation_timeline(events: list, file=None, cap: int = 50) -> None:
@@ -264,6 +295,151 @@ def _memory_timeline(events: list, file=None, cap: int = 50) -> None:
           f"rejects={rejects}", file=file)
 
 
+def _file_rank(path: str, events: list) -> int:
+    """Rank of one trace file: the ``.rank<i>`` filename suffix wins,
+    else the first event carrying a ``rank`` field, else 0."""
+    import re
+
+    m = re.search(r"\.rank(\d+)$", path)
+    if m:
+        return int(m.group(1))
+    for e in events:
+        r = e.get("rank")
+        if isinstance(r, int):
+            return r
+    return 0
+
+
+def _anchor_ts(events: list):
+    """Per-rank alignment anchor: the distributed bring-up health record
+    is the one event every rank emits at (nearly) the same real moment —
+    the group barrier inside jax.distributed.initialize.  Fallbacks:
+    any health record (mesh bring-up), then the first timestamp."""
+    for pred in (
+        lambda e: e.get("type") == "health"
+        and e.get("source") == "distributed_init",
+        lambda e: e.get("type") == "health",
+        lambda e: True,
+    ):
+        for e in events:
+            if pred(e) and isinstance(e.get("ts"), (int, float)):
+                return e["ts"]
+    return None
+
+
+def _merge_line(e: dict) -> str:
+    """One compact description for the merged timeline."""
+    t = e.get("type", "?")
+    if t == "health":
+        return (f"health    {e.get('source', '?')}"
+                f" outcome={e.get('outcome', '?')}")
+    if t == "fault":
+        return (f"fault     {e.get('site', '?')} mode={e.get('mode', '?')}"
+                f" call={e.get('call', '?')}")
+    if t == "degrade":
+        return (f"degrade   {e.get('site', '?')} {e.get('action', '?')}"
+                f" {e.get('from', '')}->{e.get('to', '')}")
+    if t == "slow_flush":
+        return (f"slow_flush {e.get('label', '?')}"
+                f" rung={e.get('rung', '?')} x{e.get('slowdown', '?')}")
+    if t == "cache_evict":
+        return f"cache_evict {e.get('key', '?')}"
+    if t == "flush_error":
+        return (f"flush_err {e.get('label', '?')}"
+                f" {str(e.get('error', ''))[:60]}")
+    if t == "memory":
+        return (f"memory    {e.get('action', '?')}"
+                f" {_fmt_bytes(e.get('bytes', e.get('over_bytes', 0)) or 0)}")
+    if t == "flush":
+        return (f"flush     {e.get('label', '?')}"
+                f" rung={e.get('degraded', 'fused')}"
+                f" wall={e.get('wall_s', 0):.4f}s")
+    return t
+
+
+def merge_report(path: str, per_rank: dict, file=None, cap: int = 80) -> None:
+    """Cross-rank merged timeline + rank-divergence analysis.
+
+    ``per_rank`` maps rank -> event list.  Per-rank clock skew is
+    estimated from the bring-up anchor (see ``_anchor_ts``) and
+    subtracted, then all ranks' noteworthy events are interleaved by
+    adjusted timestamp (seq breaks ties within a rank).  Divergence
+    check: walking each rank's flush stream in lockstep order, every
+    position where ranks disagree on program label or degradation rung
+    is flagged — one rank degrading to ``chunked`` while another stayed
+    ``fused`` is how SPMD runs deadlock in collectives, and it is
+    invisible in any single-rank view."""
+    file = file or sys.stdout
+    ranks = sorted(per_rank)
+    total = sum(len(v) for v in per_rank.values())
+    print(f"== merged timeline: {path} ({len(ranks)} rank(s), "
+          f"{total} events) ==", file=file)
+    anchors = {r: _anchor_ts(per_rank[r]) for r in ranks}
+    known = [a for a in anchors.values() if a is not None]
+    base = min(known) if known else 0.0
+    skew = {r: (anchors[r] - base if anchors[r] is not None else 0.0)
+            for r in ranks}
+    print("rank skew (vs earliest anchor): " + "  ".join(
+        f"r{r}={skew[r]:+.4f}s" for r in ranks), file=file)
+
+    merged = []
+    for r in ranks:
+        for e in per_rank[r]:
+            ts = e.get("ts")
+            if not isinstance(ts, (int, float)):
+                continue
+            merged.append((ts - skew[r], e.get("seq", 0), r, e))
+    merged.sort(key=lambda t: (t[0], t[1], t[2]))
+    t0 = merged[0][0] if merged else 0.0
+
+    def noteworthy(e: dict) -> bool:
+        t = e.get("type")
+        if t in ("fault", "degrade", "slow_flush", "cache_evict",
+                 "flush_error", "health"):
+            return True
+        if t == "memory":
+            return not (e.get("action") == "admit" and e.get("ok"))
+        if t == "flush":
+            return "degraded" in e
+        return False
+
+    shown = [m for m in merged if noteworthy(m[3])]
+    print(f"noteworthy events ({len(shown)} of {len(merged)} stamped):",
+          file=file)
+    for adj, _seq, r, e in shown[:cap]:
+        print(f"  +{adj - t0:8.3f}s r{r}  {_merge_line(e)}", file=file)
+    if len(shown) > cap:
+        print(f"  ... and {len(shown) - cap} more", file=file)
+
+    # --- rank divergence over the lockstep flush streams ---
+    streams = {
+        r: [e for e in per_rank[r] if e.get("type") == "flush"]
+        for r in ranks
+    }
+    counts = {r: len(streams[r]) for r in ranks}
+    if len(ranks) < 2:
+        print("rank divergence: single rank, nothing to compare", file=file)
+        return
+    diverged = []
+    depth = min(counts.values())
+    for i in range(depth):
+        labels = {r: streams[r][i].get("label", "?") for r in ranks}
+        rungs = {r: streams[r][i].get("degraded", "fused") for r in ranks}
+        if len(set(labels.values())) > 1 or len(set(rungs.values())) > 1:
+            diverged.append((i, labels, rungs))
+    if len(set(counts.values())) > 1:
+        print("rank divergence: flush-count mismatch " + "  ".join(
+            f"r{r}={counts[r]}" for r in ranks), file=file)
+    for i, labels, rungs in diverged[:20]:
+        print(f"rank divergence at flush #{i}: " + "  ".join(
+            f"r{r}={labels[r]}/{rungs[r]}" for r in ranks), file=file)
+    if len(diverged) > 20:
+        print(f"  ... and {len(diverged) - 20} more", file=file)
+    if not diverged and len(set(counts.values())) == 1:
+        print(f"rank divergence: none ({depth} lockstep flushes, "
+              "labels and rungs agree)", file=file)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Summarize RAMBA_TRACE JSONL trace files."
@@ -272,7 +448,26 @@ def main(argv=None) -> int:
                     help="trace file(s); .rank* siblings auto-discovered")
     ap.add_argument("--top", type=int, default=10,
                     help="programs to list (default 10)")
+    ap.add_argument("--merge-ranks", action="store_true",
+                    help="interleave per-rank files into one skew-adjusted"
+                         " timeline and flag rank divergence")
+    ap.add_argument("--merge-cap", type=int, default=80,
+                    help="max merged timeline lines (default 80)")
     args = ap.parse_args(argv)
+
+    if args.merge_ranks:
+        for p in args.paths:
+            found = _discover(p)
+            if not found:
+                print(f"{p}: no trace file found", file=sys.stderr)
+                return 2
+            per_rank: dict = {}
+            for f in found:
+                evs = _load(f)
+                r = _file_rank(f, evs)
+                per_rank.setdefault(r, []).extend(evs)
+            merge_report(p, per_rank, cap=args.merge_cap)
+        return 0
 
     files = []
     for p in args.paths:

@@ -37,6 +37,16 @@ estimate is a pure function of the program, so both ranks route to the
 NOT exercised here: multi-controller arrays are not fully addressable, so
 the governor refuses to spill them (memory.py) — the leg asserts the
 admission/chunked path, which is the part that must stay rank-lockstepped.
+
+``--perf-leg`` runs the kernel-cost-ledger acceptance leg: the same
+2-rank SPMD topology under ``RAMBA_PERF=1``; both ranks run an identical
+flush sequence and print the sorted kernel fingerprints from their cost
+ledgers (observe/ledger.py).  The runner asserts the two sets are
+IDENTICAL — the fingerprints are a pure function of program structure +
+donation + semantic regime, so any rank skew here means the ranks
+compiled different programs — and then runs
+``scripts/trace_report.py --merge-ranks`` over the per-rank traces to
+prove the cross-rank merged timeline works end to end.
 """
 
 from __future__ import annotations
@@ -109,6 +119,127 @@ assert chunked, diagnostics.last_flushes(20)
 print('MEMORY_LEG_OK rank=%d rejects=%d' % (
     rank, c.get('memory.admission_rejects', 0)))
 """
+
+
+# SPMD workload for the perf leg: each rank forms the process group, runs
+# the same flush sequence twice (so every kernel has both a miss and a
+# hit), and prints its ledger's sorted kernel fingerprints for the runner
+# to compare across ranks.  argv: <rank> <coordinator>.
+_PERF_WORKLOAD = """
+import sys
+import numpy as np
+rank, coord = int(sys.argv[1]), sys.argv[2]
+from ramba_tpu.parallel import distributed
+distributed.initialize(coordinator_address=coord, num_processes=2,
+                       process_id=rank)
+import jax
+assert jax.process_count() == 2, jax.process_count()
+import ramba_tpu as rt
+for _ in range(3):
+    a = rt.arange(8192) * 2.0 + 1.0
+    s = float(rt.sum(a))
+    b = rt.sqrt(rt.arange(4096) + 1.0)
+    s2 = float(rt.sum(b))
+exp = float(np.sum(np.arange(8192) * 2.0 + 1.0))
+assert abs(s - exp) <= 1e-5 * abs(exp), (s, exp)
+from ramba_tpu import diagnostics
+rep = diagnostics.perf_report()
+keys = sorted(rep['kernels'])
+assert keys, rep
+execs = sum(k['exec']['count'] for k in rep['kernels'].values())
+assert execs >= 1, rep
+print('PERF_LEG_KEYS rank=%d %s' % (rank, ','.join(keys)))
+"""
+
+
+def run_perf_leg() -> int:
+    """Two ranks under RAMBA_PERF=1; both ledgers must report the same
+    kernel fingerprint set, and the merged timeline must build."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    basetemp = tempfile.mkdtemp(prefix="ramba_2proc_perf_")
+    trace_base = os.path.join(basetemp, "trace.jsonl")
+    budget = float(os.environ.get("RAMBA_TEST_PROCS_TIMEOUT", "600"))
+
+    procs, logs = [], []
+    for rank in range(2):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        for k in ("RAMBA_TEST_PROCS", "RAMBA_TEST_PROC_ID",
+                  "RAMBA_TEST_COORD", "RAMBA_TEST_SHARED_TMP",
+                  "RAMBA_PROFILE_DIR", "RAMBA_FAULTS", "RAMBA_HBM_BUDGET"):
+            env.pop(k, None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["RAMBA_PERF"] = "1"
+        env["RAMBA_TRACE"] = trace_base
+        log = open(os.path.join(basetemp, f"rank{rank}.log"), "w")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _PERF_WORKLOAD, str(rank),
+             f"localhost:{port}"],
+            env=env, stdout=log, stderr=subprocess.STDOUT, cwd=REPO,
+        ))
+
+    deadline = time.time() + budget
+    rcs = [None, None]
+    try:
+        for i, p in enumerate(procs):
+            left = max(5.0, deadline - time.time())
+            try:
+                rcs[i] = p.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                rcs[i] = -9
+    finally:
+        for log in logs:
+            log.close()
+
+    ok = all(rc == 0 for rc in rcs)
+
+    # Both ranks' ledgers must report the identical kernel-key set:
+    # fingerprints are structure-stable, so SPMD lockstep => equal sets.
+    keysets = [None, None]
+    for rank in range(2):
+        path = os.path.join(basetemp, f"rank{rank}.log")
+        with open(path) as f:
+            tail = f.read().splitlines()
+        for line in tail:
+            if line.startswith(f"PERF_LEG_KEYS rank={rank} "):
+                keysets[rank] = line.split(" ", 2)[2]
+        if keysets[rank] is None:
+            ok = False
+        print(f"--- perf leg rank {rank} rc={rcs[rank]} ({path}) ---")
+        print("\n".join(tail[-(4 if ok else 40):]))
+    if ok and keysets[0] != keysets[1]:
+        print(f"perf leg: FAIL (kernel keys diverge: "
+              f"r0={keysets[0]} r1={keysets[1]})")
+        ok = False
+    elif ok:
+        nkeys = len((keysets[0] or "").split(","))
+        print(f"perf leg: {nkeys} kernel keys, identical on both ranks")
+
+    # The cross-rank merged timeline must build from the per-rank traces
+    # and see both ranks in lockstep.
+    if ok:
+        merged = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "trace_report.py"),
+             trace_base, "--merge-ranks"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        print(merged.stdout.strip())
+        if (merged.returncode != 0
+                or "2 rank(s)" not in merged.stdout
+                or "rank divergence: none" not in merged.stdout):
+            print(f"perf leg: FAIL (merge-ranks rc={merged.returncode})")
+            print(merged.stderr.strip())
+            ok = False
+
+    print(f"two-process perf leg: {'OK' if ok else 'FAIL'}")
+    if ok:
+        shutil.rmtree(basetemp, ignore_errors=True)
+    return 0 if ok else 1
 
 
 def run_memory_leg() -> int:
@@ -284,6 +415,8 @@ def main() -> int:
         return run_fault_leg()
     if "--memory-leg" in sys.argv[1:]:
         return run_memory_leg()
+    if "--perf-leg" in sys.argv[1:]:
+        return run_perf_leg()
     pytest_args = sys.argv[1:] or ["tests/"]
     with socket.socket() as s:
         s.bind(("localhost", 0))
