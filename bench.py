@@ -323,6 +323,61 @@ def _bench_matmul(rt, platform, floor):
     return res
 
 
+def _bench_serving(rt, platform):
+    """Multi-tenant serving section: 4 concurrent sessions streaming
+    async flushes through the shared compile pipeline
+    (ramba_tpu/serve/).  Two numbers feed scripts/perf_diff.py:
+    ``serving_flushes_per_s`` (aggregate enqueue->done throughput, where
+    coalescing and cache-warm back-to-back dispatch earn their keep) and
+    ``serving_p95_flush_ms`` (tail latency of one flush ticket under
+    cross-tenant contention — the fairness queue bounds how long one
+    tenant's burst can hold up another's p95)."""
+    import threading
+
+    from ramba_tpu import serve
+
+    n_sessions = 4
+    per_session = 24 if platform != "cpu" else 8
+    n = 262_144 if platform != "cpu" else 16_384
+    lat, lock = [], threading.Lock()
+    errs = []
+
+    def worker(i):
+        try:
+            with serve.Session(tenant=f"bench{i}") as s:
+                for _ in range(per_session):
+                    a = rt.arange(n) * 2.0 + float(i)
+                    t0 = time.perf_counter()
+                    s.flush(wait=True)
+                    dt = time.perf_counter() - t0
+                    with lock:
+                        lat.append(dt)
+                    del a
+        except Exception as e:  # noqa: BLE001
+            errs.append(repr(e)[:200])
+
+    worker(0)  # warm-up: compile once outside the timed window
+    lat.clear()
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_sessions)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    serve.shutdown()
+    if errs:
+        raise RuntimeError("; ".join(errs[:3]))
+    lat.sort()
+    p95 = lat[min(len(lat) - 1, int(0.95 * len(lat)))]
+    return {
+        "serving_flushes_per_s": round(len(lat) / wall, 1),
+        "serving_p95_flush_ms": round(p95 * 1e3, 2),
+        "serving_sessions": n_sessions,
+    }
+
+
 def _bench_dispatch_floor(rt):
     """Measured per-dispatch round-trip cost (flush + scalar fetch of a
     tiny computation): on a tunneled chip this floor dominates small
@@ -475,6 +530,11 @@ def main():
             out.update(_bench_matmul(rt, platform, floor))
         except Exception:  # noqa: BLE001
             out["matmul_error"] = traceback.format_exc(limit=2)[-300:]
+
+        try:
+            out.update(_bench_serving(rt, platform))
+        except Exception:  # noqa: BLE001
+            out["serving_error"] = traceback.format_exc(limit=2)[-300:]
     except Exception:  # noqa: BLE001 - even import/backend failure emits JSON
         out["error"] = traceback.format_exc(limit=3)[-400:]
 

@@ -97,6 +97,7 @@ from ramba_tpu.parallel.constraints import (  # noqa: F401
 )
 from ramba_tpu.utils.remote import get, jit, remote  # noqa: F401
 from ramba_tpu.utils import debug  # noqa: F401
+from ramba_tpu import serve  # noqa: F401
 from ramba_tpu import diagnostics  # noqa: F401
 from ramba_tpu import observe  # noqa: F401
 from ramba_tpu import resilience  # noqa: F401

@@ -23,15 +23,31 @@ Differences, by design:
   buffer that no live ndarray aliases is donated to XLA so e.g. ``a += 1``
   updates HBM in place (the reference's alias analysis for this is
   ramba.py:8435-8465).
+
+Since the serving refactor, pending state is *per stream*: each
+:class:`FlushStream` owns its own pending registry, node-count threshold,
+and quarantine scope, so concurrent sessions (``ramba_tpu.serve``) cannot
+flush — or poison — each other's half-built programs.  A process-wide
+default stream preserves the historical single-stream behavior verbatim;
+``_pending`` below IS the default stream's registry dict.  A flush is two
+stages — :func:`_flush_prepare` (collect + rewrite + linearize + donation
+census + verify, cheap, caller thread) and :func:`_flush_dispatch`
+(admission + ladder execution + write-back) — shared by the synchronous
+path here and the async compile pipeline in ``serve/pipeline.py`` so the
+two can never drift.
 """
 
 from __future__ import annotations
 
+import contextvars
 import hashlib
+import itertools
 import os
+import threading
 import time
 import warnings
 import weakref
+from contextlib import contextmanager
 from typing import Optional, Sequence
 
 import jax
@@ -63,74 +79,355 @@ def _nbytes(v) -> int:
     except Exception:
         return 0
 
-# ndarrays with a pending (non-Const) expression — the reference keeps the
-# analogous set as DAG nodes ordered by seq_no (ramba.py:4387-4548).
-# Keyed by id() with weakref values: a WeakSet would compare members with
-# ``==``, which on an array type is elementwise and would trigger
-# materialization from inside the registry itself.
-_pending: dict[int, "weakref.ref"] = {}
+
+# ---------------------------------------------------------------------------
+# cross-stream shared state + its locks
+# ---------------------------------------------------------------------------
 
 # id(buffer) -> number of live ndarrays whose materialized value IS that
 # buffer.  Zero owners at flush time means nothing can observe the buffer
 # after this flush, so it is safe to donate.
 _const_owners: dict[int, int] = {}
+_census_lock = threading.RLock()
 
-_nodes_since_flush = 0
+# id(leaf value) -> number of prepared-but-not-finished flushes holding it
+# as a program input.  A buffer referenced by MORE than one in-flight
+# program must not be donated by any of them: streams can share subgraphs
+# (and therefore leaves) and a donation in stream A would hand stream B a
+# deleted buffer.  On the single default stream exactly one flush is ever
+# in flight, so the count is always 1 and the donation decision reduces to
+# the historical owners==0 test.
+_inflight_leaves: dict[int, int] = {}
+_flight_lock = threading.Lock()
 
 # Bounded LRU compile cache; entries from an old mesh epoch are purged on
 # the first flush after set_mesh (their sharding constraints baked in the old
 # mesh), and user-function keys (fromfunction/apply statics) can't pin
 # unbounded executables.  dict preserves insertion order and a hit re-inserts
 # its key, so iteration order IS recency order and eviction pops the LRU.
+# Shared by every stream (a program's structure is tenant-independent —
+# sharing IS what makes coalesced dispatch compile-cache-warm) and guarded
+# by _cache_lock now that streams flush concurrently.
 _compile_cache: "dict" = {}
 _COMPILE_CACHE_MAX = 512
 _cache_epoch = 0
+_cache_lock = threading.RLock()
 
-# Monotone flush counter (observability; cf. reference dag-count history,
-# ramba.py:5120-5128).
+# Monotone flush counters (observability; cf. reference dag-count history,
+# ramba.py:5120-5128).  Process-wide across all streams.
 stats = {"flushes": 0, "compiles": 0, "nodes_flushed": 0, "segments": 0}
+_stats_lock = threading.Lock()
+
+
+# ---------------------------------------------------------------------------
+# flush streams
+# ---------------------------------------------------------------------------
+
+_stream_ids = itertools.count(1)
+
+
+class FlushStream:
+    """Session-scoped pending registry + flush scope.
+
+    One per serving session (``serve.Session``), plus the process-wide
+    default stream.  Each stream owns:
+
+    * its pending registry (ndarrays with a non-Const expression),
+    * its ``nodes_since_flush`` counter and ``max_pending_ops`` threshold
+      (one tenant's build burst can no longer force-flush another
+      tenant's half-built program),
+    * its quarantine scope — a flush failure unregisters only THIS
+      stream's roots, and
+    * its flush ordering: ``_flush_lock`` serializes flushes of the same
+      stream (concurrent flushes of one stream would double-execute and
+      double-donate the same roots), while different streams flush
+      concurrently.
+    """
+
+    __slots__ = ("stream_id", "name", "tenant", "max_pending_ops",
+                 "quota_bytes", "on_threshold", "inflight", "stats",
+                 "nodes_since_flush", "_pending", "_lock", "_flush_lock",
+                 "__weakref__")
+
+    def __init__(self, name: Optional[str] = None,
+                 tenant: Optional[str] = None,
+                 max_pending_ops: Optional[int] = None,
+                 quota_bytes: Optional[int] = None):
+        self.stream_id = next(_stream_ids)
+        self.name = name or f"stream{self.stream_id}"
+        self.tenant = tenant
+        # None -> the process-wide common.max_pending_ops default
+        self.max_pending_ops = max_pending_ops
+        # per-tenant HBM quota enforced by memory-governor admission
+        self.quota_bytes = quota_bytes
+        # hook the serving session installs so threshold auto-flushes go
+        # through the async pipeline instead of blocking the build thread
+        self.on_threshold = None
+        # in-flight async work (objects with .wait()); serve/pipeline.py
+        # maintains this so drain()/materialization can rendezvous
+        self.inflight: list = []
+        self.stats = {"flushes": 0, "nodes_flushed": 0, "quarantined": 0,
+                      "enqueued": 0}
+        self.nodes_since_flush = 0
+        self._pending: dict[int, "weakref.ref"] = {}
+        self._lock = threading.RLock()
+        self._flush_lock = threading.RLock()
+        _streams.add(self)
+
+    def __repr__(self):
+        return (f"<FlushStream {self.name!r} tenant={self.tenant!r} "
+                f"pending={len(self._pending)}>")
+
+    # -- registry ----------------------------------------------------------
+
+    def register(self, arr) -> None:
+        k = id(arr)
+
+        def _cleanup(ref, _k=k, _s=self):
+            with _s._lock:
+                if _s._pending.get(_k) is ref:
+                    del _s._pending[_k]
+                else:
+                    return
+            with _reg_lock:
+                if _arr_streams.get(_k) is _s:
+                    del _arr_streams[_k]
+
+        with self._lock:
+            self._pending[k] = weakref.ref(arr, _cleanup)
+
+    def unregister(self, arr) -> None:
+        with self._lock:
+            self._pending.pop(id(arr), None)
+
+    def pending_arrays(self) -> list:
+        out = []
+        with self._lock:
+            refs = list(self._pending.values())
+        for r in refs:
+            a = r()
+            if a is not None:
+                out.append(a)
+        return out
+
+    def pending_roots(self) -> list:
+        """Pending ndarrays in deterministic (creation) order — the program
+        the next flush of this stream will run is defined by this set."""
+        roots = [a for a in self.pending_arrays()
+                 if not isinstance(a._expr, Const)]
+        roots.sort(key=lambda a: a._seq)
+        return roots
+
+    def _collect(self, *, detach: bool = False) -> list:
+        """Atomically snapshot the roots of the next flush and reset the
+        node counter.  ``detach`` (the async-enqueue path) additionally
+        removes the roots from the registry so a later enqueue cannot
+        collect — and double-execute — the same work; the returned strong
+        references keep the arrays alive until write-back."""
+        with self._lock:
+            self.nodes_since_flush = 0
+            roots = []
+            for r in list(self._pending.values()):
+                a = r()
+                if a is not None and not isinstance(a._expr, Const):
+                    roots.append(a)
+            roots.sort(key=lambda a: a._seq)
+            if detach:
+                for a in roots:
+                    self._pending.pop(id(a), None)
+        if detach and roots:
+            with _reg_lock:
+                for a in roots:
+                    if _arr_streams.get(id(a)) is self:
+                        del _arr_streams[id(a)]
+        return roots
+
+    # -- thresholds --------------------------------------------------------
+
+    def note_node_created(self) -> None:
+        """Forced-flush safety valve for unbounded build loops — per
+        stream, so one tenant's burst only flushes that tenant's work."""
+        with self._lock:
+            self.nodes_since_flush += 1
+            cap = self.max_pending_ops
+            if cap is None:
+                cap = common.max_pending_ops
+            fire = cap and self.nodes_since_flush >= cap
+        if fire:
+            hook = self.on_threshold
+            if hook is not None:
+                hook(self)
+            else:
+                self.flush()
+
+    # -- flushing ----------------------------------------------------------
+
+    def flush(self, extra: Sequence[Expr] = ()) -> list:
+        """Synchronously materialize this stream's pending ndarrays (and
+        ``extra`` expressions).  Returns the values of ``extra`` in
+        order."""
+        with self._flush_lock, stream_scope(self):
+            roots = self._collect()
+            work = _flush_prepare(self, roots, extra)
+            if work is None:
+                return []
+            return _flush_dispatch(work)
+
+    def drain(self) -> None:
+        """Wait for every in-flight async flush of this stream (enqueued
+        via serve/pipeline.py) to finish.  Failures surface through the
+        tickets / later materialization, not here."""
+        for t in list(self.inflight):
+            wait = getattr(t, "wait", None)
+            if wait is not None:
+                try:
+                    wait()
+                except Exception:
+                    pass
+
+
+# All live streams (weak — a dropped session's stream must be collectable).
+# FlushStream has no __eq__, so WeakSet membership is identity, as needed.
+_streams: "weakref.WeakSet[FlushStream]" = weakref.WeakSet()
+
+#: The process-wide default stream: everything outside a serve.Session.
+_default_stream = FlushStream(name="default")
+
+# Historical module-level registry — tests and debug tooling reach for
+# ``fuser._pending`` directly; it IS the default stream's dict (the default
+# stream only ever mutates, never replaces, this object).
+_pending = _default_stream._pending
+
+# id(arr) -> owning FlushStream for every pending ndarray, so
+# materialization can flush the stream that owns the work regardless of
+# which thread/session touches the array.
+_arr_streams: dict[int, FlushStream] = {}
+_reg_lock = threading.RLock()
+
+_current_stream: "contextvars.ContextVar[Optional[FlushStream]]" = \
+    contextvars.ContextVar("ramba_flush_stream", default=None)
+
+
+def current_stream() -> FlushStream:
+    s = _current_stream.get()
+    return s if s is not None else _default_stream
+
+
+def default_stream() -> FlushStream:
+    return _default_stream
+
+
+def current_tenant() -> Optional[str]:
+    s = _current_stream.get()
+    return s.tenant if s is not None else None
+
+
+@contextmanager
+def stream_scope(stream: FlushStream):
+    """Make ``stream`` the current stream for the calling context (new
+    lazy arrays register into it; ledger/counter attribution follows)."""
+    token = _current_stream.set(stream)
+    try:
+        yield stream
+    finally:
+        _current_stream.reset(token)
+
+
+def activate_stream(stream: FlushStream):
+    """Non-contextmanager activation (serve.Session.__enter__); returns
+    the token for :func:`deactivate_stream`."""
+    return _current_stream.set(stream)
+
+
+def deactivate_stream(token) -> None:
+    _current_stream.reset(token)
+
+
+def all_streams() -> list:
+    """Live streams, default first, then by creation order."""
+    out = [s for s in list(_streams) if s is not _default_stream]
+    out.sort(key=lambda s: s.stream_id)
+    return [_default_stream] + out
+
+
+def stream_of(arr) -> FlushStream:
+    """The stream that owns ``arr``'s pending work (current stream when
+    the array is not pending anywhere — e.g. already materialized or
+    quarantined)."""
+    with _reg_lock:
+        s = _arr_streams.get(id(arr))
+    return s if s is not None else current_stream()
 
 
 def register_pending(arr) -> None:
     k = id(arr)
-
-    def _cleanup(ref, _k=k):
-        if _pending.get(_k) is ref:
-            del _pending[_k]
-
-    _pending[k] = weakref.ref(arr, _cleanup)
+    with _reg_lock:
+        s = _arr_streams.get(k)
+        if s is None:
+            s = current_stream()
+            _arr_streams[k] = s
+    s.register(arr)
 
 
 def unregister_pending(arr) -> None:
-    _pending.pop(id(arr), None)
+    k = id(arr)
+    with _reg_lock:
+        s = _arr_streams.pop(k, None)
+    if s is not None:
+        s.unregister(arr)
+    else:
+        # never registered under a stream (or already collected); make the
+        # historical contract hold for direct callers
+        _default_stream.unregister(arr)
 
 
 def _pending_arrays() -> list:
+    """Every pending ndarray across ALL streams (debug tooling and the
+    sync barrier read this; per-stream work uses the stream's own)."""
     out = []
-    for r in list(_pending.values()):
-        a = r()
-        if a is not None:
-            out.append(a)
+    for s in all_streams():
+        out.extend(s.pending_arrays())
     return out
+
+
+def note_node_created(arr=None) -> None:
+    """Per-stream forced-flush safety valve.  With ``arr`` given, the
+    counter/threshold of the *owning* stream advances; bare calls charge
+    the current stream (historical signature)."""
+    if arr is not None:
+        stream_of(arr).note_node_created()
+    else:
+        current_stream().note_node_created()
+
+
+# ---------------------------------------------------------------------------
+# owner census (shared across streams; donation safety)
+# ---------------------------------------------------------------------------
 
 
 def owner_incref(buf, const=None) -> None:
     """Count one more live ndarray owning ``buf``.  When the owning
     ``Const`` node is supplied (ndarray._set_expr does), the buffer is
     also registered with the memory governor's live-bytes ledger."""
-    _const_owners[id(buf)] = _const_owners.get(id(buf), 0) + 1
+    with _census_lock:
+        _const_owners[id(buf)] = _const_owners.get(id(buf), 0) + 1
+    # outside the census lock: the memory ledger takes its own lock and
+    # (on spill) calls back into owner_rekey — nesting would deadlock
     if const is not None:
         _memory.on_incref(const)
 
 
 def owner_decref(buf) -> None:
     k = id(buf)
-    n = _const_owners.get(k, 0) - 1
-    if n <= 0:
-        _const_owners.pop(k, None)
+    with _census_lock:
+        n = _const_owners.get(k, 0) - 1
+        released = n <= 0
+        if released:
+            _const_owners.pop(k, None)
+        else:
+            _const_owners[k] = n
+    if released:
         _memory.on_release(buf)
-    else:
-        _const_owners[k] = n
 
 
 def owner_rekey(old, new) -> None:
@@ -138,9 +435,10 @@ def owner_rekey(old, new) -> None:
     value object (device array ↔ host spill wrapper): the count follows
     the buffer identity, so the donation decision at the next flush sees
     the same aliasing it would have seen without the spill."""
-    n = _const_owners.pop(id(old), 0)
-    if n > 0:
-        _const_owners[id(new)] = _const_owners.get(id(new), 0) + n
+    with _census_lock:
+        n = _const_owners.pop(id(old), 0)
+        if n > 0:
+            _const_owners[id(new)] = _const_owners.get(id(new), 0) + n
 
 
 def leaf_value(leaf):
@@ -152,12 +450,24 @@ def leaf_value(leaf):
     return v
 
 
-def note_node_created() -> None:
-    """Forced-flush safety valve for unbounded build loops."""
-    global _nodes_since_flush
-    _nodes_since_flush += 1
-    if _nodes_since_flush >= common.max_pending_ops:
-        flush()
+def _flight_incref(leaf_vals) -> list:
+    keys = []
+    with _flight_lock:
+        for v in leaf_vals:
+            k = id(v)
+            _inflight_leaves[k] = _inflight_leaves.get(k, 0) + 1
+            keys.append(k)
+    return keys
+
+
+def _flight_decref(keys) -> None:
+    with _flight_lock:
+        for k in keys:
+            n = _inflight_leaves.get(k, 0) - 1
+            if n <= 0:
+                _inflight_leaves.pop(k, None)
+            else:
+                _inflight_leaves[k] = n
 
 
 class _Program:
@@ -249,11 +559,9 @@ def _build_callable(program: _Program):
 
 
 def _pending_roots() -> list:
-    """Pending ndarrays in deterministic (creation) order — the program the
-    next flush will run is defined by this set."""
-    roots = [a for a in _pending_arrays() if not isinstance(a._expr, Const)]
-    roots.sort(key=lambda a: a._seq)
-    return roots
+    """Pending ndarrays of the CURRENT stream in deterministic (creation)
+    order — the program the next flush will run is defined by this set."""
+    return current_stream().pending_roots()
 
 
 def _prepare_program(exprs: Sequence[Expr]):
@@ -307,36 +615,42 @@ def _cache_key(program: _Program, donate_key: tuple) -> tuple:
 def _get_compiled(program: _Program, donate_key: tuple):
     """Compile-cache lookup (mesh-epoch aware, true LRU).  Returns
     ``(fn, is_new, fingerprint)`` where ``fingerprint`` is the stable
-    per-kernel key the cost ledger files this program under."""
+    per-kernel key the cost ledger files this program under.  The whole
+    lookup runs under ``_cache_lock`` — jax.jit object creation is lazy
+    (the expensive XLA compile happens at first *call*, outside), so the
+    critical section stays short while concurrent streams can never
+    corrupt the LRU order or double-count a miss."""
     global _cache_epoch
-    if _cache_epoch != _mesh.mesh_epoch:
-        _compile_cache.clear()
-        _cache_epoch = _mesh.mesh_epoch
-    key = _cache_key(program, donate_key)
-    fp = _ledger.fingerprint(key)
-    fn = _compile_cache.pop(key, None)
-    if fn is not None:
-        _compile_cache[key] = fn  # re-insert: move to MRU position
-        _registry.inc("fuser.cache_hit")
-        _ledger.record_cache(fp, "hit")
-        return fn, False, fp
-    if len(_compile_cache) >= _COMPILE_CACHE_MAX:
-        old_key = next(iter(_compile_cache))  # LRU: least recently used
-        _compile_cache.pop(old_key)
-        _registry.inc("fuser.cache_evict")
-        _ledger.record_cache(_ledger.fingerprint(old_key), "evict")
-        _events.emit({
-            "type": "cache_evict",
-            "key": _ledger.fingerprint(old_key),
-            "capacity": _COMPILE_CACHE_MAX,
-        })
-    _faults.check("compile", instrs=len(program.instrs))
-    fn = jax.jit(_build_callable(program), donate_argnums=donate_key)
-    _compile_cache[key] = fn
-    stats["compiles"] += 1
-    _registry.inc("fuser.cache_miss")
-    _ledger.record_cache(fp, "miss")
-    return fn, True, fp
+    with _cache_lock:
+        if _cache_epoch != _mesh.mesh_epoch:
+            _compile_cache.clear()
+            _cache_epoch = _mesh.mesh_epoch
+        key = _cache_key(program, donate_key)
+        fp = _ledger.fingerprint(key)
+        fn = _compile_cache.pop(key, None)
+        if fn is not None:
+            _compile_cache[key] = fn  # re-insert: move to MRU position
+            _registry.inc("fuser.cache_hit")
+            _ledger.record_cache(fp, "hit")
+            return fn, False, fp
+        if len(_compile_cache) >= _COMPILE_CACHE_MAX:
+            old_key = next(iter(_compile_cache))  # LRU: least recently used
+            _compile_cache.pop(old_key)
+            _registry.inc("fuser.cache_evict")
+            _ledger.record_cache(_ledger.fingerprint(old_key), "evict")
+            _events.emit({
+                "type": "cache_evict",
+                "key": _ledger.fingerprint(old_key),
+                "capacity": _COMPILE_CACHE_MAX,
+            })
+        _faults.check("compile", instrs=len(program.instrs))
+        fn = jax.jit(_build_callable(program), donate_argnums=donate_key)
+        _compile_cache[key] = fn
+        with _stats_lock:
+            stats["compiles"] += 1
+        _registry.inc("fuser.cache_miss")
+        _ledger.record_cache(fp, "miss")
+        return fn, True, fp
 
 
 def _last_use_map(program: _Program) -> dict:
@@ -474,7 +788,8 @@ def _run_segmented(program: _Program, leaf_vals: list, donate_idx: tuple,
                 del vals[s]
         for s, v in zip(out_here, outs):
             vals[s] = v
-        stats["segments"] += 1
+        with _stats_lock:
+            stats["segments"] += 1
         _registry.inc("fuser.segments")
     return tuple(vals[s] for s in program.out_slots)
 
@@ -563,6 +878,7 @@ def _execute_compiled(fn, program: _Program, leaf_vals, is_new: bool,
             is_new, bytes_in=bytes_in,
             bytes_out=sum(_nbytes(o) for o in outs),
             donated=donated, sync_seconds=sync_dt,
+            tenant=current_tenant(),
         )
     if span is not None:
         span["calls"].append({
@@ -604,6 +920,7 @@ def _run_eager(program: _Program, leaf_vals, span: Optional[dict]):
         _program_label(program), len(program.instrs), "eager", dt, False,
         bytes_in=sum(_nbytes(v) for v in leaf_vals),
         bytes_out=sum(_nbytes(o) for o in outs),
+        tenant=current_tenant(),
     )
     if span is not None:
         span["calls"].append({
@@ -650,6 +967,7 @@ def _run_host(program: _Program, leaf_vals, span: Optional[dict]):
         _program_label(program), len(program.instrs), "host", dt, False,
         bytes_in=sum(_nbytes(v) for v in leaf_vals),
         bytes_out=sum(_nbytes(o) for o in res),
+        tenant=current_tenant(),
     )
     if span is not None:
         span["calls"].append({
@@ -662,7 +980,8 @@ def _run_host(program: _Program, leaf_vals, span: Optional[dict]):
 
 def _execute_resilient(program: _Program, leaf_vals, donate_key: tuple,
                        span: Optional[dict], skip_fused: bool = False,
-                       route_chunked: bool = False):
+                       route_chunked: bool = False,
+                       tags: Optional[dict] = None):
     """Run the program down the degradation ladder (see
     ``resilience.degrade``): fused → split → chunked → eager → host.
     Returns ``(outs, rung_name)``; rung_name is "fused" on the healthy
@@ -679,7 +998,10 @@ def _execute_resilient(program: _Program, leaf_vals, donate_key: tuple,
     starts the ladder at the chunked rung — and, uniquely among
     below-fused rungs, KEEPS the donate mask: no failed attempt has
     consumed anything yet, and donating dead leaves is exactly what
-    bounds the chunked peak."""
+    bounds the chunked peak.
+
+    ``tags`` (e.g. ``{"tenant": ...}``) ride on every degrade event the
+    ladder emits so the degradation timeline attributes to a tenant."""
     rungs = []
     if not skip_fused and not route_chunked:
         rungs.append(
@@ -714,16 +1036,19 @@ def _execute_resilient(program: _Program, leaf_vals, donate_key: tuple,
                 return False
         return True
 
-    return _degrade.run_ladder("flush", rungs, leaf_check=leaves_alive)
+    return _degrade.run_ladder("flush", rungs, leaf_check=leaves_alive,
+                               tags=tags)
 
 
 def _leaf_owner_counts(leaves) -> list:
     """Live-alias census per leaf slot: how many materialized ndarrays still
     own each Const leaf's buffer (Scalar leaves own nothing)."""
-    return [
-        _const_owners.get(id(leaf.value), 0) if isinstance(leaf, Const) else 0
-        for leaf in leaves
-    ]
+    with _census_lock:
+        return [
+            _const_owners.get(id(leaf.value), 0)
+            if isinstance(leaf, Const) else 0
+            for leaf in leaves
+        ]
 
 
 def _program_event(program: _Program, leaves, donate_key: tuple,
@@ -780,50 +1105,50 @@ def _verify_if_enabled(program: _Program, leaves, exprs, donate_key: tuple,
     return True
 
 
-def flush(extra: Sequence[Expr] = ()) -> list:
-    """Materialize every pending ndarray (and ``extra`` expressions) in one
-    fused jit call (or, above ``common.max_program_instrs`` instructions, a
-    chain of bounded jit calls — see ``_run_segmented``).  Returns the
-    values of ``extra`` in order."""
-    global _nodes_since_flush
-    _nodes_since_flush = 0
-    roots = _pending_roots()
-    exprs = [a._expr for a in roots] + list(extra)
-    if not exprs:
-        return []
-    t_flush = time.perf_counter()
-    rw_before = None
-    if common.rewrite_enabled:
-        from ramba_tpu.core.rewrite import stats as _rw_stats
+# ---------------------------------------------------------------------------
+# the staged flush: prepare (cheap, caller thread) -> dispatch (execution)
+# ---------------------------------------------------------------------------
 
-        rw_before = dict(_rw_stats)
-    program, leaves, vexprs = _prepare_program(exprs)
-    linearize_s = time.perf_counter() - t_flush
-    rewrite_fires = {}
-    if rw_before is not None:
-        from ramba_tpu.core.rewrite import stats as _rw_stats
 
-        rewrite_fires = {
-            k: v - rw_before.get(k, 0)
-            for k, v in _rw_stats.items()
-            if v != rw_before.get(k, 0)
-        }
-    label = _program_label(program)
-    span = {
-        "type": "flush",
-        "label": label,
-        "instrs": len(program.instrs),
-        "n_leaves": program.n_leaves,
-        "n_roots": len(roots),
-        "linearize_s": round(linearize_s, 6),
-        "rewrite_fires": rewrite_fires,
-        "calls": [],
-    }
+class _FlushWork:
+    """Everything one flush needs between prepare and dispatch — the unit
+    the async pipeline queues.  Holds STRONG references to the roots (a
+    detached root left the pending registry at collect time and must not
+    be collected before write-back) and to the leaf values (pinned +
+    flight-counted until dispatch releases them)."""
 
-    donate = []
+    __slots__ = ("stream", "roots", "root_exprs", "extra_n", "program",
+                 "leaves", "vexprs", "leaf_vals", "donate_key", "span",
+                 "label", "fingerprint", "skip_fused", "pins", "flight",
+                 "t_flush", "detached", "enqueued_at")
+
+    def __init__(self, stream, roots, extra_n):
+        self.stream = stream
+        self.roots = roots
+        self.root_exprs = [a._expr for a in roots]
+        self.extra_n = extra_n
+        self.program = None
+        self.leaves = None
+        self.vexprs = None
+        self.leaf_vals = None
+        self.donate_key = ()
+        self.span = None
+        self.label = "?"
+        self.fingerprint = None
+        self.skip_fused = False
+        self.pins = ()
+        self.flight = ()
+        self.t_flush = 0.0
+        self.detached = False
+        self.enqueued_at = None
+
+
+def _gather_leaf_vals(leaves):
+    """Resolve leaf values for execution (restoring memory-governor
+    spills).  Returns ``(leaf_vals, leaf_bytes)``."""
     leaf_vals = []
     leaf_bytes = 0
-    for i, leaf in enumerate(leaves):
+    for leaf in leaves:
         if isinstance(leaf, Const):
             v = leaf.value
             if isinstance(v, _SpilledArray):
@@ -832,74 +1157,235 @@ def flush(extra: Sequence[Expr] = ()) -> list:
                 v = _memory.restore(leaf)
             leaf_vals.append(v)
             leaf_bytes += _nbytes(v)
-            if (
-                _nbytes(v) >= DONATE_MIN_BYTES
-                and _const_owners.get(id(v), 0) == 0
-            ):
-                donate.append(i)
         else:
             leaf_vals.append(leaf.value)
-    donate_key = tuple(donate)
+    return leaf_vals, leaf_bytes
+
+
+def _donation_mask(leaves, leaf_vals) -> tuple:
+    """Donate-eligible leaf slots: big enough, owned by no live ndarray,
+    and held by no OTHER in-flight flush (each flush's own flight pin
+    counts one, so a single stream behaves exactly as before)."""
+    donate = []
+    with _census_lock:
+        owners = [
+            _const_owners.get(id(v), 0) if isinstance(leaf, Const) else 1
+            for leaf, v in zip(leaves, leaf_vals)
+        ]
+    with _flight_lock:
+        flights = [_inflight_leaves.get(id(v), 0) for v in leaf_vals]
+    for i, (leaf, v) in enumerate(zip(leaves, leaf_vals)):
+        if not isinstance(leaf, Const):
+            continue
+        if (
+            _nbytes(v) >= DONATE_MIN_BYTES
+            and owners[i] == 0
+            and flights[i] <= 1
+        ):
+            donate.append(i)
+    return tuple(donate)
+
+
+def _quarantine(work: "_FlushWork", e: Exception) -> None:
+    """Quarantine: every rung of the ladder failed (or the error was
+    fatal).  The roots of THIS program must leave the pending registry,
+    or the one broken expression re-enters — and re-fails — every
+    subsequent flush of its stream, cascading one error into unbounded
+    collateral failures.  The arrays keep their lazy graphs; a later
+    materialization re-attempts each one alone (ndarray._value), so
+    innocent co-pending arrays still produce their values and only the
+    truly broken graph re-raises.  Per-stream: other streams' pending
+    work is untouched."""
+    for arr in work.roots:
+        unregister_pending(arr)  # no-op when the work was detached
+    n = len(work.roots)
+    work.stream.stats["quarantined"] += n
+    _registry.inc("resilience.flush_quarantined", n)
+    ev = {
+        "type": "flush_error", "label": work.label,
+        "quarantined": n,
+        "error": f"{type(e).__name__}: {e}"[:300],
+    }
+    if work.stream.tenant is not None:
+        ev["tenant"] = work.stream.tenant
+    _events.emit(ev)
+
+
+def _release(work: "_FlushWork") -> None:
+    _memory.ledger.unpin(work.pins)
+    work.pins = ()
+    _flight_decref(work.flight)
+    work.flight = ()
+
+
+def _flush_prepare(stream: FlushStream, roots: list,
+                   extra: Sequence[Expr] = (), *,
+                   detached: bool = False) -> Optional["_FlushWork"]:
+    """Stage 1 of a flush: rewrite + linearize, open the span, gather
+    leaf values, take the donation census, emit the program event, pin
+    the leaves, and run the RAMBA_VERIFY verifier.  Cheap relative to
+    execution — this is the part an async enqueue runs on the caller
+    thread.  Returns None when there is nothing to run.
+
+    ``detached`` marks work whose roots already left the pending registry
+    (async enqueue): any failure here must quarantine them, or they would
+    silently vanish.  On the synchronous path only a verifier rejection
+    quarantines (matching the historical single-stream flush)."""
+    exprs = [a._expr for a in roots] + list(extra)
+    if not exprs:
+        return None
+    work = _FlushWork(stream, roots, len(exprs) - len(roots))
+    work.detached = detached
+    work.t_flush = time.perf_counter()
     try:
-        _faults.check("donate_census", donated=len(donate_key))
-    except _faults.InjectedFault:
-        # Deliberately corrupt the donate mask (ignore the alias census) —
-        # the seeded violation the RAMBA_VERIFY donation-hazard rule exists
-        # to catch.  Only reachable under explicit fault injection.
-        donate_key = tuple(
-            i for i, leaf in enumerate(leaves) if isinstance(leaf, Const)
-        )
-    span["donated"] = len(donate_key)
-    span["leaf_bytes"] = leaf_bytes
-    span["mem_live_bytes"] = _memory.ledger.live_bytes
-    if _events.trace_enabled():
-        _events.emit(_program_event(program, leaves, donate_key, label))
-    _profile.ensure_started()
-    # In-flight leaves are never spill candidates: admission-triggered (or
-    # oom-triggered) eviction during THIS flush must not pull a buffer the
-    # program is about to read.
-    _mem_pins = _memory.ledger.pin_values(leaf_vals)
+        rw_before = None
+        if common.rewrite_enabled:
+            from ramba_tpu.core.rewrite import stats as _rw_stats
+
+            rw_before = dict(_rw_stats)
+        program, leaves, vexprs = _prepare_program(exprs)
+        linearize_s = time.perf_counter() - work.t_flush
+        rewrite_fires = {}
+        if rw_before is not None:
+            from ramba_tpu.core.rewrite import stats as _rw_stats
+
+            rewrite_fires = {
+                k: v - rw_before.get(k, 0)
+                for k, v in _rw_stats.items()
+                if v != rw_before.get(k, 0)
+            }
+        label = _program_label(program)
+        span = {
+            "type": "flush",
+            "label": label,
+            "instrs": len(program.instrs),
+            "n_leaves": program.n_leaves,
+            "n_roots": len(roots),
+            "linearize_s": round(linearize_s, 6),
+            "rewrite_fires": rewrite_fires,
+            "calls": [],
+        }
+        if stream is not _default_stream:
+            span["stream"] = stream.name
+        if stream.tenant is not None:
+            span["tenant"] = stream.tenant
+        work.program, work.leaves, work.vexprs = program, leaves, vexprs
+        work.label, work.span = label, span
+
+        leaf_vals, leaf_bytes = _gather_leaf_vals(leaves)
+        work.leaf_vals = leaf_vals
+        work.flight = _flight_incref(leaf_vals)
+        donate_key = _donation_mask(leaves, leaf_vals)
+        try:
+            _faults.check("donate_census", donated=len(donate_key))
+        except _faults.InjectedFault:
+            # Deliberately corrupt the donate mask (ignore the alias
+            # census) — the seeded violation the RAMBA_VERIFY
+            # donation-hazard rule exists to catch.  Only reachable under
+            # explicit fault injection.
+            donate_key = tuple(
+                i for i, leaf in enumerate(leaves) if isinstance(leaf, Const)
+            )
+        work.donate_key = donate_key
+        span["donated"] = len(donate_key)
+        span["leaf_bytes"] = leaf_bytes
+        span["mem_live_bytes"] = _memory.ledger.live_bytes
+        if _events.trace_enabled():
+            _events.emit(_program_event(program, leaves, donate_key, label))
+        _profile.ensure_started()
+        # In-flight leaves are never spill candidates: admission-triggered
+        # (or oom-triggered) eviction during THIS flush must not pull a
+        # buffer the program is about to read.
+        work.pins = _memory.ledger.pin_values(leaf_vals)
+    except Exception as e:
+        if detached:
+            _quarantine(work, e)
+        _release(work)
+        raise
     try:
-        skip_fused = _verify_if_enabled(
+        work.skip_fused = _verify_if_enabled(
             program, leaves, vexprs, donate_key, span, label
         )
-        route_chunked = _memory.admit(program, leaf_vals, donate_key, span)
+    except Exception as e:
+        _quarantine(work, e)
+        _release(work)
+        raise
+    work.fingerprint = _ledger.fingerprint(_cache_key(program, donate_key))
+    return work
+
+
+def _revalidate_donation(work: "_FlushWork") -> None:
+    """Async work dispatches arbitrarily later than it was prepared: a
+    buffer that looked donate-safe at enqueue may since have gained a
+    live owner (the user materialized an alias) or another in-flight
+    program (a different stream enqueued a graph sharing the leaf).
+    Donation may only SHRINK here — a smaller mask cannot introduce the
+    hazards the enqueue-time verifier checked for."""
+    if not work.donate_key:
+        return
+    fresh = set(_donation_mask(work.leaves, work.leaf_vals))
+    kept = tuple(i for i in work.donate_key if i in fresh)
+    if kept != work.donate_key:
+        work.span["donate_revoked"] = len(work.donate_key) - len(kept)
+        work.donate_key = kept
+        work.span["donated"] = len(kept)
+        work.fingerprint = _ledger.fingerprint(
+            _cache_key(work.program, kept))
+
+
+def _flush_dispatch(work: "_FlushWork", *, coalesced: int = 0) -> list:
+    """Stage 2 of a flush: admission control, ladder execution, Const
+    write-back, span finalization.  Returns the values of the work's
+    ``extra`` expressions.  Runs on the caller thread (sync path) or the
+    pipeline's compile worker (async path)."""
+    stream, span, program = work.stream, work.span, work.program
+    roots, label = work.roots, work.label
+    if work.enqueued_at is not None:
+        span["queue_s"] = round(time.perf_counter() - work.enqueued_at, 6)
+    if coalesced > 1:
+        span["coalesced"] = coalesced
+    tags = {"tenant": stream.tenant} if stream.tenant is not None else None
+    leaf_vals = work.leaf_vals
+    try:
+        if work.detached:
+            _revalidate_donation(work)
+        route_chunked = _memory.admit(program, leaf_vals, work.donate_key,
+                                      span, tenant=stream.tenant,
+                                      quota=stream.quota_bytes)
         with _profile.annotation("ramba_flush:" + label):
             with warnings.catch_warnings():
                 warnings.filterwarnings("ignore", message=".*[Dd]onat.*")
                 outs, rung = _execute_resilient(program, leaf_vals,
-                                                donate_key, span,
-                                                skip_fused=skip_fused,
-                                                route_chunked=route_chunked)
+                                                work.donate_key, span,
+                                                skip_fused=work.skip_fused,
+                                                route_chunked=route_chunked,
+                                                tags=tags)
     except Exception as e:
-        # Quarantine: every rung of the ladder failed (or the error was
-        # fatal).  The roots of THIS program must leave the pending
-        # registry, or the one broken expression re-enters — and re-fails —
-        # every subsequent flush in the process, cascading one error into
-        # unbounded collateral failures.  The arrays keep their lazy
-        # graphs; a later materialization re-attempts each one alone
-        # (ndarray._value), so innocent co-pending arrays still produce
-        # their values and only the truly broken graph re-raises.
-        for arr in roots:
-            unregister_pending(arr)
-        _registry.inc("resilience.flush_quarantined", len(roots))
-        _events.emit({
-            "type": "flush_error", "label": label,
-            "quarantined": len(roots),
-            "error": f"{type(e).__name__}: {e}"[:300],
-        })
+        _quarantine(work, e)
         raise
     finally:
-        _memory.ledger.unpin(_mem_pins)
+        _release(work)
     if rung != "fused":
         span["degraded"] = rung
-    stats["flushes"] += 1
-    stats["nodes_flushed"] += len(program.instrs)
+    with _stats_lock:
+        stats["flushes"] += 1
+        stats["nodes_flushed"] += len(program.instrs)
+    stream.stats["flushes"] += 1
+    stream.stats["nodes_flushed"] += len(program.instrs)
     _registry.inc("fuser.flushes")
     _registry.inc("fuser.nodes_flushed", len(program.instrs))
+    if stream.tenant is not None:
+        _registry.inc(f"serve.tenant.{stream.tenant}.flushes")
+        _registry.inc(f"serve.tenant.{stream.tenant}.nodes",
+                      len(program.instrs))
+    work.leaf_vals = None  # drop donated-buffer refs before write-back
     del leaf_vals
-    for arr, val in zip(roots, outs[: len(roots)]):
-        arr._set_expr(Const(val))
+    for arr, expr, val in zip(roots, work.root_exprs, outs):
+        # Async only: skip write-back if the user re-assigned the array's
+        # expression while this flush was in flight — their newer graph
+        # wins (it still references this one's nodes and will recompute).
+        if arr._expr is expr:
+            arr._set_expr(Const(val))
     calls = span["calls"]
     span["segments"] = len(calls) - 1 if len(calls) > 1 else 0
     span["compile_s"] = round(
@@ -912,13 +1398,32 @@ def flush(extra: Sequence[Expr] = ()) -> list:
         "miss" if any(c["cache"] == "miss" for c in calls) else "hit"
     )
     span["out_bytes"] = sum(_nbytes(v) for v in outs)
-    span["wall_s"] = round(time.perf_counter() - t_flush, 6)
+    span["wall_s"] = round(time.perf_counter() - work.t_flush, 6)
     _events.emit(span)
     # Slow-flush sentinel: compares this flush against the program's own
     # rolling history and emits at most one slow_flush event (after the
     # span, so the trace reads cause-then-verdict).
     _ledger.observe_flush(span)
     return list(outs[len(roots):])
+
+
+def flush(extra: Sequence[Expr] = ()) -> list:
+    """Materialize every pending ndarray of the CURRENT stream (and
+    ``extra`` expressions) in one fused jit call (or, above
+    ``common.max_program_instrs`` instructions, a chain of bounded jit
+    calls — see ``_run_segmented``).  Returns the values of ``extra`` in
+    order."""
+    return current_stream().flush(extra)
+
+
+def flush_for(arr, extra: Sequence[Expr] = ()) -> list:
+    """Flush the stream that owns ``arr``'s pending work (waiting out any
+    in-flight async flushes of that stream first), regardless of which
+    stream is current — materialization must chase the work to where it
+    was built."""
+    s = stream_of(arr)
+    s.drain()
+    return s.flush(extra)
 
 
 def analyze_pending() -> Optional[dict]:
@@ -1003,10 +1508,14 @@ def analyze_pending() -> Optional[dict]:
 
 
 def sync() -> None:
-    """Flush and wait for device completion (the reference's ``ramba.sync``
-    barriers on a remote ``nop``, ramba.py:9843-9849)."""
+    """Flush EVERY stream, wait out in-flight async work, and block until
+    device completion (the reference's ``ramba.sync`` barriers on a
+    remote ``nop``, ramba.py:9843-9849)."""
     waiters = _pending_arrays()
-    flush()
+    for s in all_streams():
+        s.flush()
+    for s in all_streams():
+        s.drain()
     jax.block_until_ready(
         [a._expr.value for a in waiters
          if isinstance(a._expr, Const)
@@ -1015,7 +1524,8 @@ def sync() -> None:
 
 
 def evaluate(expr: Expr):
-    """Evaluate one expression (flushing all pending work alongside it)."""
+    """Evaluate one expression (flushing the current stream's pending work
+    alongside it)."""
     if isinstance(expr, Const):
         return leaf_value(expr)
     return flush(extra=[expr])[0]

@@ -228,7 +228,7 @@ class ndarray:
             fuser.unregister_pending(self)
         else:
             fuser.register_pending(self)
-            fuser.note_node_created()
+            fuser.note_node_created(self)
 
     def __del__(self):
         try:
@@ -308,19 +308,27 @@ class ndarray:
         """Concrete sharded jax.Array for this array (flushes lazy work)."""
         if self._base is None:
             if not isinstance(self._expr, Const):
-                fuser.flush()
+                # flush the stream that OWNS this array's pending work
+                # (waiting out any in-flight async flushes of it first) —
+                # materialization from another thread/session must chase
+                # the work to where it was built
+                fuser.flush_for(self)
             if not isinstance(self._expr, Const):
                 # Still lazy after a flush: an earlier failed flush
-                # quarantined this array (fuser.flush pulls the roots of a
+                # quarantined this array (the fuser pulls the roots of a
                 # program that exhausted the degradation ladder out of the
                 # pending registry).  Re-attempt this graph alone — an
                 # innocent co-pending array materializes fine; a genuinely
                 # broken one re-raises its real error here.
-                self._set_expr(Const(fuser.flush(extra=[self._expr])[0]))
+                self._set_expr(Const(fuser.flush_for(self,
+                                                     extra=[self._expr])[0]))
             # leaf_value restores the buffer if the memory governor
             # spilled it to host while this array was cold
             return fuser.leaf_value(self._expr)
-        return fuser.flush(extra=[self.read_expr()])[0]
+        base = self
+        while base._base is not None:
+            base = base._base
+        return fuser.flush_for(base, extra=[self.read_expr()])[0]
 
     def asarray(self) -> np.ndarray:
         """Gather to a host NumPy array (reference: ndarray.asarray,

@@ -70,12 +70,23 @@ def perf_report() -> dict:
     return _ledger.snapshot()
 
 
+def serving_report() -> dict:
+    """Per-tenant serving rollup (flushes, nodes, quota rejects, kernel
+    executions, resident bytes) — empty outside ``serve.Session`` use."""
+    from ramba_tpu import serve as _serve
+
+    return _serve.tenant_report()
+
+
 def snapshot() -> dict:
     """Everything, JSON-serializable: registry stores + the event ring."""
     snap = _registry.snapshot()
     snap["events"] = list(_events.ring)
     snap["memory"] = memory_report()
     snap["perf"] = perf_report()
+    serving = serving_report()
+    if serving:
+        snap["serving"] = serving
     return snap
 
 
@@ -152,6 +163,18 @@ def report(file=None) -> None:
             print(line, file=file)
         if perf["slow_flushes"]:
             print(f"  slow flushes: {perf['slow_flushes']}", file=file)
+    serving = serving_report()
+    if serving:
+        print("-- serving (per tenant) --", file=file)
+        for tenant in sorted(serving):
+            row = serving[tenant]
+            print(
+                f"  {tenant:<20s} flushes={row['flushes']:<6d}"
+                f" nodes={row['nodes']:<8d} execs={row['executes']:<6d}"
+                f" live={row['live_bytes']:,d}B"
+                f" quota_rejects={row['quota_rejects']}",
+                file=file,
+            )
     fl = last_flushes()
     if fl:
         print(f"-- last {len(fl)} flush span(s) --", file=file)

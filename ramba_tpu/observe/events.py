@@ -19,8 +19,15 @@ import atexit
 import collections
 import json
 import os
+import threading
 import time
 from typing import Optional
+
+# Serializes seq assignment, the ring append, and the file write so events
+# from concurrent serving streams interleave as whole lines with strictly
+# increasing seq (deque.append alone is atomic, but seq would race and the
+# JSONL file would tear).
+_emit_lock = threading.Lock()
 
 _RING_MAX = max(1, int(os.environ.get("RAMBA_TRACE_RING", "256") or 256))
 
@@ -114,18 +121,19 @@ def emit(event: dict) -> dict:
     ts/seq/rank) and returns it.  Never raises out of the sink: a full
     disk must not take the computation down with it."""
     global _seq
-    _seq += 1
-    event.setdefault("ts", round(time.time(), 6))
-    event["seq"] = _seq
-    rank, nprocs = _rank_info() if _trace_path is not None else (None, 1)
-    if nprocs > 1:
-        event["rank"] = rank
-    ring.append(event)
-    if _trace_path is not None:
-        try:
-            _file().write(json.dumps(event, default=str) + "\n")
-        except OSError:
-            pass
+    with _emit_lock:
+        _seq += 1
+        event.setdefault("ts", round(time.time(), 6))
+        event["seq"] = _seq
+        rank, nprocs = _rank_info() if _trace_path is not None else (None, 1)
+        if nprocs > 1:
+            event["rank"] = rank
+        ring.append(event)
+        if _trace_path is not None:
+            try:
+                _file().write(json.dumps(event, default=str) + "\n")
+            except OSError:
+                pass
     return event
 
 

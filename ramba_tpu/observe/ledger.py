@@ -52,11 +52,18 @@ from __future__ import annotations
 
 import hashlib
 import os
+import threading
 from collections import deque
 from typing import Optional
 
 from ramba_tpu.observe import events as _events
 from ramba_tpu.observe import registry as _registry
+
+# Guards every mutable store below (_kernels, _flush_walls, _fp_memo, the
+# per-entry rolling windows): concurrent serving streams record into the
+# ledger from many threads.  RLock so snapshot() can call entry.summary()
+# which reads the same state.
+_lock = threading.RLock()
 
 
 # ---------------------------------------------------------------------------
@@ -210,9 +217,10 @@ def fingerprint(cache_key) -> str:
         return hashlib.sha256(_token(cache_key).encode()).hexdigest()[:12]
     if fp is None:
         fp = hashlib.sha256(_token(cache_key).encode()).hexdigest()[:12]
-        if len(_fp_memo) >= _FP_MEMO_MAX:
-            _fp_memo.clear()
-        _fp_memo[cache_key] = fp
+        with _lock:
+            if len(_fp_memo) >= _FP_MEMO_MAX:
+                _fp_memo.clear()
+            _fp_memo[cache_key] = fp
     return fp
 
 
@@ -227,7 +235,7 @@ class KernelEntry:
     __slots__ = (
         "label", "instrs", "donated", "compiles", "compile_s",
         "exec", "sync", "bytes_in", "bytes_out",
-        "hits", "misses", "evicts", "rungs",
+        "hits", "misses", "evicts", "rungs", "tenants",
         "flops", "bytes_accessed", "_cost_tried",
     )
 
@@ -245,6 +253,9 @@ class KernelEntry:
         self.misses = 0
         self.evicts = 0
         self.rungs: dict = {}
+        # tenant -> execution count (serving attribution; empty outside
+        # serve.Session so historical summaries are unchanged)
+        self.tenants: dict = {}
         self.flops: Optional[float] = None
         self.bytes_accessed: Optional[float] = None
         self._cost_tried = False
@@ -263,6 +274,8 @@ class KernelEntry:
                       "evicts": self.evicts},
             "rungs": dict(self.rungs),
         }
+        if self.tenants:
+            out["tenants"] = dict(self.tenants)
         if self.sync is not None:
             out["sync"] = self.sync.summary()
         if self.flops is not None:
@@ -292,41 +305,47 @@ def _entry(fp: str, label: Optional[str] = None, instrs: int = 0,
 
 def record_cache(fp: str, kind: str, label: Optional[str] = None) -> None:
     """One compile-cache interaction: ``kind`` in hit|miss|evict."""
-    e = _entry(fp, label)
-    if kind == "hit":
-        e.hits += 1
-    elif kind == "miss":
-        e.misses += 1
-    elif kind == "evict":
-        e.evicts += 1
+    with _lock:
+        e = _entry(fp, label)
+        if kind == "hit":
+            e.hits += 1
+        elif kind == "miss":
+            e.misses += 1
+        elif kind == "evict":
+            e.evicts += 1
 
 
 def record_execute(fp: str, label: str, instrs: int, rung: str,
                    seconds: float, is_new: bool,
                    bytes_in: int = 0, bytes_out: int = 0,
                    donated: int = 0,
-                   sync_seconds: Optional[float] = None) -> None:
+                   sync_seconds: Optional[float] = None,
+                   tenant: Optional[str] = None) -> None:
     """One execution of a compiled (or interpreted) kernel.
 
     First calls (``is_new``) pay jit trace + lower + XLA compile and are
     accounted as compile wall time, NOT as execution samples — mixing
     them in would poison the steady-state percentiles the sentinel and
-    perf_diff compare against."""
-    e = _entry(fp, label, instrs, donated)
-    e.instrs = instrs or e.instrs
-    e.donated = max(e.donated, donated)
-    e.bytes_in += int(bytes_in)
-    e.bytes_out += int(bytes_out)
-    e.rungs[rung] = e.rungs.get(rung, 0) + 1
-    if is_new:
-        e.compiles += 1
-        e.compile_s += seconds
-    else:
-        e.exec.add(seconds)
-        if sync_seconds is not None:
-            if e.sync is None:
-                e.sync = _Rolling()
-            e.sync.add(sync_seconds)
+    perf_diff compare against.  ``tenant`` (a serving session's identity)
+    accumulates a per-tenant execution count on the entry."""
+    with _lock:
+        e = _entry(fp, label, instrs, donated)
+        e.instrs = instrs or e.instrs
+        e.donated = max(e.donated, donated)
+        e.bytes_in += int(bytes_in)
+        e.bytes_out += int(bytes_out)
+        e.rungs[rung] = e.rungs.get(rung, 0) + 1
+        if tenant is not None:
+            e.tenants[tenant] = e.tenants.get(tenant, 0) + 1
+        if is_new:
+            e.compiles += 1
+            e.compile_s += seconds
+        else:
+            e.exec.add(seconds)
+            if sync_seconds is not None:
+                if e.sync is None:
+                    e.sync = _Rolling()
+                e.sync.add(sync_seconds)
 
 
 def capture_cost(fp: str, fn, leaf_vals) -> None:
@@ -337,10 +356,11 @@ def capture_cost(fp: str, fn, leaf_vals) -> None:
     cost_analysis, extended dtypes) just leaves the fields absent."""
     if not cost_enabled():
         return
-    e = _entry(fp)
-    if e._cost_tried:
-        return
-    e._cost_tried = True
+    with _lock:
+        e = _entry(fp)
+        if e._cost_tried:
+            return
+        e._cost_tried = True
     try:
         compiled = fn.lower(*leaf_vals).compile()
         ca = compiled.cost_analysis()
@@ -367,32 +387,42 @@ def observe_flush(span: dict) -> Optional[dict]:
     global _slow_flushes
     label = span.get("label", "?")
     wall = float(span.get("wall_s", 0.0) or 0.0)
-    win = _flush_walls.get(label)
-    if win is None:
-        win = _flush_walls[label] = _Rolling()
+    with _lock:
+        win = _flush_walls.get(label)
+        if win is None:
+            win = _flush_walls[label] = _Rolling()
+        fire_p50 = None
+        if _slow_factor > 0 and win.count >= _min_samples:
+            p50 = win.quantile(0.50)
+            if p50 and wall > _slow_factor * p50:
+                _slow_flushes += 1
+                fire_p50 = (p50, win.count)
+        win.add(wall)
     fired = None
-    if _slow_factor > 0 and win.count >= _min_samples:
-        p50 = win.quantile(0.50)
-        if p50 and wall > _slow_factor * p50:
-            _slow_flushes += 1
-            _registry.inc("perf.slow_flush")
-            fired = _events.emit({
-                "type": "slow_flush",
-                "label": label,
-                "rung": span.get("degraded", "fused"),
-                "wall_s": round(wall, 6),
-                "p50_s": round(p50, 6),
-                "slowdown": round(wall / p50, 2),
-                "factor": _slow_factor,
-                "samples": win.count,
-                "instrs": span.get("instrs"),
-                "bytes_in": span.get("leaf_bytes"),
-                "bytes_out": span.get("out_bytes"),
-                "compile_s": span.get("compile_s"),
-                "execute_s": span.get("execute_s"),
-                "cache": span.get("cache"),
-            })
-    win.add(wall)
+    if fire_p50 is not None:
+        p50, samples = fire_p50
+        _registry.inc("perf.slow_flush")
+        ev = {
+            "type": "slow_flush",
+            "label": label,
+            "rung": span.get("degraded", "fused"),
+            "wall_s": round(wall, 6),
+            "p50_s": round(p50, 6),
+            "slowdown": round(wall / p50, 2),
+            "factor": _slow_factor,
+            "samples": samples,
+            "instrs": span.get("instrs"),
+            "bytes_in": span.get("leaf_bytes"),
+            "bytes_out": span.get("out_bytes"),
+            "compile_s": span.get("compile_s"),
+            "execute_s": span.get("execute_s"),
+            "cache": span.get("cache"),
+        }
+        # serving attribution: the sentinel names the tenant whose flush
+        # blew past its program's history
+        if span.get("tenant") is not None:
+            ev["tenant"] = span["tenant"]
+        fired = _events.emit(ev)
     return fired
 
 
@@ -400,30 +430,34 @@ def snapshot() -> dict:
     """JSON-serializable ledger dump — the payload behind
     ``diagnostics.perf_report()``, bench.py's ``kernels`` section, and
     ``scripts/perf_diff.py`` captures."""
-    return {
-        "mode": _mode or "off",
-        "slow_flush_factor": _slow_factor,
-        "slow_flush_min_samples": _min_samples,
-        "window": _window,
-        "slow_flushes": _slow_flushes,
-        "kernels": {fp: e.summary() for fp, e in _kernels.items()},
-        "flushes": {label: w.summary() for label, w in _flush_walls.items()},
-    }
+    with _lock:
+        return {
+            "mode": _mode or "off",
+            "slow_flush_factor": _slow_factor,
+            "slow_flush_min_samples": _min_samples,
+            "window": _window,
+            "slow_flushes": _slow_flushes,
+            "kernels": {fp: e.summary() for fp, e in _kernels.items()},
+            "flushes": {label: w.summary()
+                        for label, w in _flush_walls.items()},
+        }
 
 
 def kernel_keys() -> list:
     """Sorted kernel fingerprints — SPMD ranks running in lockstep must
     report identical sets (asserted by two_process_suite --perf-leg)."""
-    return sorted(_kernels)
+    with _lock:
+        return sorted(_kernels)
 
 
 def reset() -> None:
     """Drop all accumulated state (tests/benchmarks)."""
     global _slow_flushes
-    _kernels.clear()
-    _flush_walls.clear()
-    _fp_memo.clear()
-    _slow_flushes = 0
+    with _lock:
+        _kernels.clear()
+        _flush_walls.clear()
+        _fp_memo.clear()
+        _slow_flushes = 0
 
 
 reconfigure()

@@ -19,7 +19,16 @@ XLA's profiler owns exact per-execution collective traffic.
 
 from __future__ import annotations
 
+import threading
 from collections import defaultdict
+
+# One lock for the whole store: the stores are touched together (snapshot,
+# reset) and individual updates are tiny, so finer grain buys nothing.
+# RLock because utils/timing.py wrappers alias these dicts and may be
+# called from code already holding it.  Concurrent serving sessions hammer
+# inc() from many threads — unguarded ``d[k] += n`` is a read-modify-write
+# that loses increments under contention.
+lock = threading.RLock()
 
 # occurrence / byte counters: name -> int
 counters: dict = defaultdict(int)
@@ -40,14 +49,16 @@ comm: dict = {
 
 def inc(name: str, n: int = 1) -> None:
     """Increment a named counter (hot-path safe: one dict add)."""
-    counters[name] += n
+    with lock:
+        counters[name] += n
 
 
 def gauge(name: str, value) -> None:
     """Set a counter to an absolute level (e.g. ``memory.live_bytes``) —
     same store and naming convention as :func:`inc`, but last-write-wins
     semantics for quantities that go down as well as up."""
-    counters[name] = int(value)
+    with lock:
+        counters[name] = int(value)
 
 
 def get(name: str) -> int:
@@ -57,33 +68,37 @@ def get(name: str) -> int:
 def prefixed(prefix: str) -> dict:
     """Counters under one subsystem prefix (e.g. ``prefixed("resilience.")``
     → every fault/retry/degradation counter)."""
-    return {k: v for k, v in counters.items() if k.startswith(prefix)}
+    with lock:  # iteration would break under a concurrent inc of a new key
+        return {k: v for k, v in counters.items() if k.startswith(prefix)}
 
 
 def snapshot() -> dict:
     """Point-in-time copy of every store (JSON-serializable except
     sub_timers' tuple keys, which stringify as 'parent/name')."""
-    return {
-        "counters": dict(counters),
-        "timers": {k: tuple(v) for k, v in timers.items()},
-        "sub_timers": {f"{p}/{s}": tuple(v)
-                       for (p, s), v in sub_timers.items()},
-        "per_func": {k: tuple(v) for k, v in per_func.items()},
-        "comm": dict(comm),
-    }
+    with lock:
+        return {
+            "counters": dict(counters),
+            "timers": {k: tuple(v) for k, v in timers.items()},
+            "sub_timers": {f"{p}/{s}": tuple(v)
+                           for (p, s), v in sub_timers.items()},
+            "per_func": {k: tuple(v) for k, v in per_func.items()},
+            "comm": dict(comm),
+        }
 
 
 def reset_counters() -> None:
-    counters.clear()
+    with lock:
+        counters.clear()
 
 
 def reset_timers() -> None:
     """Clear the timer stores (the historical ``timing.reset`` scope)."""
-    timers.clear()
-    sub_timers.clear()
-    per_func.clear()
-    for k in comm:
-        comm[k] = 0
+    with lock:
+        timers.clear()
+        sub_timers.clear()
+        per_func.clear()
+        for k in comm:
+            comm[k] = 0
 
 
 def reset() -> None:

@@ -48,7 +48,8 @@ LADDER = ("fused", "split", "chunked", "eager", "host")
 
 
 def run_ladder(site: str, rungs: List[Tuple[str, Callable]], *,
-               leaf_check: Optional[Callable[[], bool]] = None):
+               leaf_check: Optional[Callable[[], bool]] = None,
+               tags: Optional[dict] = None):
     """Try ``rungs`` (ordered ``(name, thunk)`` pairs) until one succeeds.
 
     Each rung runs under ``retry.call(site, thunk)``.  Returns
@@ -57,6 +58,9 @@ def run_ladder(site: str, rungs: List[Tuple[str, Callable]], *,
     rung that hit them.  ``leaf_check`` (if given) must return True for
     the ladder to continue — it guards against re-running a program whose
     donated input buffers were already consumed by a failed attempt.
+    ``tags`` (e.g. ``{"tenant": ...}`` from a serving session) ride on
+    every degrade event so the degradation timeline attributes to a
+    tenant; None adds nothing, keeping historical events byte-identical.
     """
     last: Optional[Exception] = None
     prev_name: Optional[str] = None
@@ -66,7 +70,8 @@ def run_ladder(site: str, rungs: List[Tuple[str, Callable]], *,
             _registry.inc(f"resilience.degrade.{name}")
             _events.emit({"type": "degrade", "site": site, "action": "rung",
                           "from": prev_name, "to": name,
-                          "error": _retry._errstr(last) if last else None})
+                          "error": _retry._errstr(last) if last else None,
+                          **(tags or {})})
         try:
             out = _retry.call(site, thunk)
         except Exception as e:
@@ -93,7 +98,8 @@ def run_ladder(site: str, rungs: List[Tuple[str, Callable]], *,
         if i > 0:
             _registry.inc("resilience.degrade_recovered")
             _events.emit({"type": "degrade", "site": site,
-                          "action": "recovered", "rung": name})
+                          "action": "recovered", "rung": name,
+                          **(tags or {})})
         return out, name
     assert last is not None
     raise last

@@ -53,6 +53,7 @@ from __future__ import annotations
 import itertools
 import os
 import re
+import threading
 import weakref
 from typing import Optional
 
@@ -73,6 +74,17 @@ def _is_device_array(v) -> bool:
     import jax
 
     return isinstance(v, jax.Array)
+
+
+def _current_tenant() -> Optional[str]:
+    """Tenant of the active flush stream (serving sessions), None outside
+    one.  Lazy import: the fuser imports this module at its own import."""
+    try:
+        from ramba_tpu.core import fuser as _fuser
+
+        return _fuser.current_tenant()
+    except Exception:
+        return None
 
 
 # ---------------------------------------------------------------------------
@@ -158,15 +170,18 @@ def chunk_target_bytes() -> int:
 
 
 class _Entry:
-    __slots__ = ("key", "nbytes", "consts", "seq", "pins", "spilled")
+    __slots__ = ("key", "nbytes", "consts", "seq", "pins", "spilled",
+                 "tenant")
 
-    def __init__(self, key: int, nbytes: int, seq: int, spilled: bool):
+    def __init__(self, key: int, nbytes: int, seq: int, spilled: bool,
+                 tenant: Optional[str] = None):
         self.key = key          # id() of the current value object
         self.nbytes = nbytes    # HBM footprint when resident
         self.consts: list = []  # weakrefs to the owning Const nodes
         self.seq = seq          # LRU clock: higher = touched more recently
         self.pins = 0           # >0 while a flush holds this as a leaf
         self.spilled = spilled
+        self.tenant = tenant    # serving tenant that materialized it
 
 
 class Ledger:
@@ -184,72 +199,97 @@ class Ledger:
         self.peak_live_bytes = 0
         self.evictions = 0
         self.restores = 0
+        # tenant -> resident (non-spilled) bytes, for serving quotas.
+        # Keys appear on first materialization under a serve.Session.
+        self.tenant_live: dict = {}
         self._clock = itertools.count(1)
+        # RLock: public methods lock, and evict_until -> _spill_entry
+        # re-enters.  Lock order is memory -> fuser census (owner_rekey);
+        # the fuser never calls into the ledger while holding its census
+        # lock, so the pair cannot deadlock.
+        self._lock = threading.RLock()
+
+    def _tenant_add(self, e: "_Entry", sign: int) -> None:
+        if e.tenant is None:
+            return
+        n = self.tenant_live.get(e.tenant, 0) + sign * e.nbytes
+        self.tenant_live[e.tenant] = max(0, n)
 
     # -- census hooks (called from fuser.owner_incref/owner_decref) --------
 
     def on_incref(self, const) -> None:
         v = const.value
         k = id(v)
-        e = self.entries.get(k)
-        if e is None:
-            spilled = isinstance(v, _spill.SpilledArray)
-            if not spilled and not _is_device_array(v):
-                return
-            e = _Entry(k, _nbytes(v), next(self._clock), spilled)
-            self.entries[k] = e
-            if spilled:
-                self.spilled_bytes += e.nbytes
+        with self._lock:
+            e = self.entries.get(k)
+            if e is None:
+                spilled = isinstance(v, _spill.SpilledArray)
+                if not spilled and not _is_device_array(v):
+                    return
+                e = _Entry(k, _nbytes(v), next(self._clock), spilled,
+                           tenant=_current_tenant())
+                self.entries[k] = e
+                if spilled:
+                    self.spilled_bytes += e.nbytes
+                else:
+                    self.live_bytes += e.nbytes
+                    self._tenant_add(e, +1)
+                    if self.live_bytes > self.peak_live_bytes:
+                        self.peak_live_bytes = self.live_bytes
             else:
-                self.live_bytes += e.nbytes
-                if self.live_bytes > self.peak_live_bytes:
-                    self.peak_live_bytes = self.live_bytes
-        else:
-            e.seq = next(self._clock)
-        for r in e.consts:
-            if r() is const:
-                return
-        e.consts.append(weakref.ref(const))
+                e.seq = next(self._clock)
+            for r in e.consts:
+                if r() is const:
+                    return
+            e.consts.append(weakref.ref(const))
 
     def on_release(self, value) -> None:
-        e = self.entries.pop(id(value), None)
-        if e is None:
-            return
-        if e.spilled:
-            self.spilled_bytes -= e.nbytes
-        else:
-            self.live_bytes -= e.nbytes
+        with self._lock:
+            e = self.entries.pop(id(value), None)
+            if e is None:
+                return
+            if e.spilled:
+                self.spilled_bytes -= e.nbytes
+            else:
+                self.live_bytes -= e.nbytes
+                self._tenant_add(e, -1)
 
     def _drop(self, e: "_Entry") -> None:
         """Remove an entry whose owners all died without a decref."""
-        self.entries.pop(e.key, None)
-        if e.spilled:
-            self.spilled_bytes -= e.nbytes
-        else:
-            self.live_bytes -= e.nbytes
+        with self._lock:
+            if self.entries.pop(e.key, None) is None:
+                return
+            if e.spilled:
+                self.spilled_bytes -= e.nbytes
+            else:
+                self.live_bytes -= e.nbytes
+                self._tenant_add(e, -1)
 
     # -- pinning (in-flight flush leaves are never spill candidates) -------
 
     def pin_values(self, vals) -> list:
         keys = []
-        for v in vals:
-            e = self.entries.get(id(v))
-            if e is not None:
-                e.pins += 1
-                e.seq = next(self._clock)
-                keys.append(e.key)
+        with self._lock:
+            for v in vals:
+                e = self.entries.get(id(v))
+                if e is not None:
+                    e.pins += 1
+                    e.seq = next(self._clock)
+                    keys.append(e.key)
         return keys
 
     def unpin(self, keys) -> None:
-        for k in keys:
-            e = self.entries.get(k)
-            if e is not None and e.pins > 0:
-                e.pins -= 1
+        with self._lock:
+            for k in keys:
+                e = self.entries.get(k)
+                if e is not None and e.pins > 0:
+                    e.pins -= 1
 
     def touch(self, value) -> None:
-        e = self.entries.get(id(value))
-        if e is not None:
-            e.seq = next(self._clock)
+        with self._lock:
+            e = self.entries.get(id(value))
+            if e is not None:
+                e.seq = next(self._clock)
 
     # -- spill / restore ----------------------------------------------------
 
@@ -257,7 +297,8 @@ class Ledger:
         return [c for c in (r() for r in e.consts) if c is not None]
 
     def _spill_entry(self, e: "_Entry") -> int:
-        """Spill one resident entry to host.  Returns HBM bytes freed."""
+        """Spill one resident entry to host.  Returns HBM bytes freed.
+        Caller must hold ``self._lock``."""
         if e.spilled or e.pins:
             return 0
         consts = self._live_consts(e)
@@ -286,6 +327,7 @@ class Ledger:
         e.spilled = True
         self.entries[e.key] = e
         self.live_bytes -= e.nbytes
+        self._tenant_add(e, -1)
         self.spilled_bytes += e.nbytes
         self.evictions += 1
         _registry.inc("memory.evictions")
@@ -301,33 +343,35 @@ class Ledger:
     def restore(self, const):
         """Bring a spilled Const back onto the device (all sibling Consts
         sharing the buffer are updated) and return the jax.Array."""
-        wrapper = const.value
-        if not isinstance(wrapper, _spill.SpilledArray):
-            return wrapper
-        e = self.entries.get(id(wrapper))
-        arr = _spill.restore_to_device(wrapper)
-        consts = self._live_consts(e) if e is not None else []
-        if not any(c is const for c in consts):
-            consts.append(const)
-        for c in consts:
-            c.value = arr
-        from ramba_tpu.core import fuser as _fuser
+        with self._lock:
+            wrapper = const.value
+            if not isinstance(wrapper, _spill.SpilledArray):
+                return wrapper
+            e = self.entries.get(id(wrapper))
+            arr = _spill.restore_to_device(wrapper)
+            consts = self._live_consts(e) if e is not None else []
+            if not any(c is const for c in consts):
+                consts.append(const)
+            for c in consts:
+                c.value = arr
+            from ramba_tpu.core import fuser as _fuser
 
-        _fuser.owner_rekey(wrapper, arr)
-        nbytes = _nbytes(arr) or wrapper.device_nbytes
-        if e is not None:
-            del self.entries[e.key]
-            e.key = id(arr)
-            e.consts = [weakref.ref(c) for c in consts]
-            e.spilled = False
-            e.seq = next(self._clock)
-            self.entries[e.key] = e
-            self.spilled_bytes -= e.nbytes
-            e.nbytes = nbytes
-            self.live_bytes += e.nbytes
-            if self.live_bytes > self.peak_live_bytes:
-                self.peak_live_bytes = self.live_bytes
-        self.restores += 1
+            _fuser.owner_rekey(wrapper, arr)
+            nbytes = _nbytes(arr) or wrapper.device_nbytes
+            if e is not None:
+                del self.entries[e.key]
+                e.key = id(arr)
+                e.consts = [weakref.ref(c) for c in consts]
+                e.spilled = False
+                e.seq = next(self._clock)
+                self.entries[e.key] = e
+                self.spilled_bytes -= e.nbytes
+                e.nbytes = nbytes
+                self.live_bytes += e.nbytes
+                self._tenant_add(e, +1)
+                if self.live_bytes > self.peak_live_bytes:
+                    self.peak_live_bytes = self.live_bytes
+            self.restores += 1
         _registry.inc("memory.restores")
         _update_gauges(self)
         _events.emit({
@@ -337,54 +381,66 @@ class Ledger:
         })
         return arr
 
-    def evict_until(self, need: int) -> int:
+    def evict_until(self, need: int, tenant: Optional[str] = None) -> int:
         """Spill LRU-coldest candidates until ``need`` bytes are freed (or
-        candidates run out).  Returns bytes actually freed."""
-        freed = 0
-        cands = [e for e in list(self.entries.values())
-                 if not e.spilled and not e.pins]
-        cands.sort(key=lambda e: e.seq)
-        for e in cands:
-            if freed >= need:
-                break
-            freed += self._spill_entry(e)
-        return freed
+        candidates run out).  Returns bytes actually freed.  ``tenant``
+        restricts candidates to that tenant's own entries — quota
+        enforcement must reclaim from the over-quota tenant, never evict
+        a neighbor to make room for it."""
+        with self._lock:
+            freed = 0
+            cands = [e for e in list(self.entries.values())
+                     if not e.spilled and not e.pins
+                     and (tenant is None or e.tenant == tenant)]
+            cands.sort(key=lambda e: e.seq)
+            for e in cands:
+                if freed >= need:
+                    break
+                freed += self._spill_entry(e)
+            return freed
 
     # -- reporting ----------------------------------------------------------
 
     def snapshot(self, top: int = 5) -> dict:
-        rows = []
-        pinned = 0
-        for e in list(self.entries.values()):
-            consts = self._live_consts(e)
-            if not consts:
-                self._drop(e)
-                continue
-            if e.pins and not e.spilled:
-                pinned += e.nbytes
-            v = consts[0].value
-            rows.append({
-                "nbytes": e.nbytes,
-                "shape": list(getattr(v, "shape", ())),
-                "dtype": str(getattr(v, "dtype", "?")),
-                "spilled": e.spilled,
-                "pinned": e.pins,
-                "owners": len(consts),
-            })
-        rows.sort(key=lambda r: r["nbytes"], reverse=True)
-        _update_gauges(self)
-        return {
-            "budget_bytes": budget_bytes(),
-            "watermark_bytes": watermark_bytes(),
-            "live_bytes": self.live_bytes,
-            "spilled_bytes": self.spilled_bytes,
-            "pinned_bytes": pinned,
-            "peak_live_bytes": self.peak_live_bytes,
-            "evictions": self.evictions,
-            "restores": self.restores,
-            "arrays": len(rows),
-            "top": rows[:top],
-        }
+        with self._lock:
+            rows = []
+            pinned = 0
+            for e in list(self.entries.values()):
+                consts = self._live_consts(e)
+                if not consts:
+                    self._drop(e)
+                    continue
+                if e.pins and not e.spilled:
+                    pinned += e.nbytes
+                v = consts[0].value
+                rows.append({
+                    "nbytes": e.nbytes,
+                    "shape": list(getattr(v, "shape", ())),
+                    "dtype": str(getattr(v, "dtype", "?")),
+                    "spilled": e.spilled,
+                    "pinned": e.pins,
+                    "owners": len(consts),
+                    **({"tenant": e.tenant} if e.tenant else {}),
+                })
+            rows.sort(key=lambda r: r["nbytes"], reverse=True)
+            _update_gauges(self)
+            out = {
+                "budget_bytes": budget_bytes(),
+                "watermark_bytes": watermark_bytes(),
+                "live_bytes": self.live_bytes,
+                "spilled_bytes": self.spilled_bytes,
+                "pinned_bytes": pinned,
+                "peak_live_bytes": self.peak_live_bytes,
+                "evictions": self.evictions,
+                "restores": self.restores,
+                "arrays": len(rows),
+                "top": rows[:top],
+            }
+            if any(self.tenant_live.values()):
+                out["tenant_live_bytes"] = {
+                    t: b for t, b in sorted(self.tenant_live.items()) if b
+                }
+            return out
 
 
 def _update_gauges(led: "Ledger") -> None:
@@ -486,11 +542,32 @@ def estimate_program_bytes(program, leaf_vals, donate=()) -> int:
 # ---------------------------------------------------------------------------
 
 
-def admit(program, leaf_vals, donate_key, span: Optional[dict] = None) -> bool:
-    """Pre-flush admission check.  Returns True when the flush should be
-    routed to the ``chunked`` rung (it does not fit under the watermark
-    even after eviction); False admits the fused path.  No-op (False)
-    when no budget is known."""
+def _resident_overlap(leaf_vals, tenant: Optional[str] = None) -> int:
+    """Resident bytes among ``leaf_vals`` already counted by the ledger
+    (optionally only entries belonging to ``tenant``): the program
+    estimate counts its arguments too, so they must not be double-billed.
+    Caller need not hold the ledger lock."""
+    resident = 0
+    seen: set = set()
+    with ledger._lock:
+        for v in leaf_vals:
+            k = id(v)
+            if k in seen:
+                continue
+            seen.add(k)
+            e = ledger.entries.get(k)
+            if e is not None and not e.spilled and (
+                tenant is None or e.tenant == tenant
+            ):
+                resident += e.nbytes
+    return resident
+
+
+def _admit_budget(program, leaf_vals, donate_key,
+                  span: Optional[dict] = None) -> bool:
+    """The global-budget admission leg (historical ``admit`` body).
+    Returns True to route chunked.  No-op (False) when no budget is
+    known."""
     budget = budget_bytes()
     if budget is None:
         return False
@@ -499,16 +576,7 @@ def admit(program, leaf_vals, donate_key, span: Optional[dict] = None) -> bool:
     # ledger.live already counts this flush's resident leaves; the program
     # estimate counts its arguments too — subtract the overlap so leaves
     # are not double-billed.
-    resident = 0
-    seen: set = set()
-    for v in leaf_vals:
-        k = id(v)
-        if k in seen:
-            continue
-        seen.add(k)
-        e = ledger.entries.get(k)
-        if e is not None and not e.spilled:
-            resident += e.nbytes
+    resident = _resident_overlap(leaf_vals)
     other = max(0, ledger.live_bytes - resident)
     projected = other + est
     if span is not None:
@@ -543,6 +611,55 @@ def admit(program, leaf_vals, donate_key, span: Optional[dict] = None) -> bool:
     if span is not None:
         span["admission"] = "chunked"
     return True
+
+
+def _admit_tenant(program, leaf_vals, donate_key, span: Optional[dict],
+                  tenant: str, quota: int) -> bool:
+    """Per-tenant quota admission (serving sessions).  Independent of the
+    global budget — quotas must work on budgetless backends (CPU tests)
+    — and reclaims only from the over-quota tenant's OWN entries before
+    routing its flush chunked: a tenant blowing its quota degrades that
+    tenant, never a neighbor."""
+    est = estimate_program_bytes(program, leaf_vals, donate_key)
+    with ledger._lock:
+        tenant_resident = ledger.tenant_live.get(tenant, 0)
+    other = max(0, tenant_resident - _resident_overlap(leaf_vals, tenant))
+    projected = other + est
+    if projected <= quota:
+        return False
+    freed = ledger.evict_until(projected - quota, tenant=tenant)
+    if projected - freed <= quota:
+        if span is not None:
+            span["tenant_admission"] = "evicted"
+        return False
+    _registry.inc("serve.quota_rejects")
+    _registry.inc(f"serve.tenant.{tenant}.quota_rejects")
+    _events.emit({
+        "type": "memory", "action": "reject", "route": "chunked",
+        "tenant": tenant, "quota_bytes": quota,
+        "est_bytes": est, "freed_bytes": freed,
+        "over_bytes": projected - freed - quota,
+    })
+    if span is not None:
+        span["tenant_admission"] = "chunked"
+    return True
+
+
+def admit(program, leaf_vals, donate_key, span: Optional[dict] = None, *,
+          tenant: Optional[str] = None,
+          quota: Optional[int] = None) -> bool:
+    """Pre-flush admission check.  Returns True when the flush should be
+    routed to the ``chunked`` rung — it does not fit under the global
+    watermark even after eviction, OR it would push ``tenant`` past its
+    serving ``quota`` even after evicting that tenant's own cold arrays;
+    False admits the fused path.  The global leg is a no-op (False) when
+    no budget is known; the tenant leg runs whenever a quota is given."""
+    route = _admit_budget(program, leaf_vals, donate_key, span)
+    if tenant is not None and quota:
+        if _admit_tenant(program, leaf_vals, donate_key, span, tenant,
+                         int(quota)):
+            route = True
+    return route
 
 
 _OOM_BYTES_RE = re.compile(r"(\d{4,})\s*bytes|[Aa]llocating\s+(\d+)")

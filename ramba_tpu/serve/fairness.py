@@ -1,0 +1,110 @@
+"""Round-robin fairness between tenants with queued flush work.
+
+The compile pipeline's queue.  Two invariants:
+
+* **FIFO within a tenant** — a tenant's flushes dispatch in the order it
+  enqueued them.  This is also a distributed-correctness requirement:
+  under multi-controller SPMD every rank must dispatch the same programs
+  in the same order or their collectives deadlock, so coalescing below
+  only ever takes items from queue *heads* (it can reorder BETWEEN
+  tenants, which is safe single-controller and disabled for SPMD serving
+  — see ``scripts/two_process_suite.py --serving-leg``).
+* **Round-robin between tenants** — the next dispatch comes from the
+  next tenant in rotation that has work, so one tenant enqueueing 10k
+  flushes delays the others by at most one batch, not 10k.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, deque
+from typing import Callable, List, Optional
+
+
+class RoundRobin:
+    """Per-tenant FIFO queues with round-robin popping and head-only
+    fingerprint coalescing."""
+
+    def __init__(self):
+        # tenant -> deque (insertion order gives the stable rotation base)
+        self._queues: "OrderedDict[str, deque]" = OrderedDict()
+        self._rotation: List[str] = []
+        self._next = 0
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._closed = False
+
+    def push(self, tenant: str, item) -> None:
+        with self._cond:
+            q = self._queues.get(tenant)
+            if q is None:
+                q = self._queues[tenant] = deque()
+                self._rotation.append(tenant)
+            q.append(item)
+            self._cond.notify()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return sum(len(q) for q in self._queues.values())
+
+    def close(self) -> None:
+        """Wake every waiting pop_group with an empty result."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def _pop_one(self):
+        """Next (tenant, item) in rotation; caller holds the lock and has
+        checked that some queue is non-empty."""
+        n = len(self._rotation)
+        for off in range(n):
+            tenant = self._rotation[(self._next + off) % n]
+            q = self._queues.get(tenant)
+            if q:
+                self._next = (self._next + off + 1) % n
+                return tenant, q.popleft()
+        raise AssertionError("pop on empty rotation")
+
+    def pop_group(self, max_group: int,
+                  fingerprint_of: Optional[Callable] = None,
+                  timeout: Optional[float] = None) -> list:
+        """Block until work is available, then return the next batch.
+
+        The batch starts with the round-robin next item; when
+        ``fingerprint_of`` is given and ``max_group > 1``, it is extended
+        with queue-HEAD items whose fingerprint matches — first more
+        consecutive items from the same tenant's queue (their programs
+        are identical, so dispatching them back-to-back is
+        compile-cache-warm), then matching heads of the other tenants'
+        queues in rotation order.  Only heads are taken, so every
+        tenant's FIFO order survives coalescing.
+
+        Returns ``[]`` on close() or timeout.
+        """
+        with self._cond:
+            while not self._closed and not any(self._queues.values()):
+                if not self._cond.wait(timeout=timeout):
+                    return []
+            if self._closed and not any(self._queues.values()):
+                return []
+            tenant, first = self._pop_one()
+            group = [first]
+            if fingerprint_of is None or max_group <= 1:
+                return group
+            fp = fingerprint_of(first)
+            if fp is None:
+                return group
+            q = self._queues.get(tenant)
+            while q and len(group) < max_group and \
+                    fingerprint_of(q[0]) == fp:
+                group.append(q.popleft())
+            for other in self._rotation:
+                if len(group) >= max_group:
+                    break
+                if other == tenant:
+                    continue
+                oq = self._queues.get(other)
+                while oq and len(group) < max_group and \
+                        fingerprint_of(oq[0]) == fp:
+                    group.append(oq.popleft())
+            return group

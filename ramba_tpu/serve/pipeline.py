@@ -1,0 +1,227 @@
+"""Async compile/dispatch pipeline: enqueue on the caller, execute on a
+background worker.
+
+A synchronous flush pays trace + verify + admission + compile + execute
+on the calling thread.  The pipeline splits it along the fuser's own
+staging seam (``fuser._flush_prepare`` / ``fuser._flush_dispatch``):
+
+* **enqueue** (caller thread, cheap): atomically detach the stream's
+  pending roots, rewrite + linearize, donation census, RAMBA_VERIFY,
+  fingerprint.  Returns a :class:`FlushTicket` immediately — the build
+  thread goes back to building.
+* **dispatch** (worker thread): admission control, the degradation
+  ladder, Const write-back.  Every per-program guarantee — retry
+  budgets, ladder rungs, quarantine, HBM admission — runs exactly as in
+  a synchronous flush because it IS the same code.
+
+ONE worker serves the whole process.  That is a deliberate throughput
+choice, not a simplification: dispatches funnel into one device anyway
+(jax dispatch holds the GIL; the device serializes execution), so extra
+workers would only add lock contention — while a single worker gives
+back-to-back dispatch of coalesced same-fingerprint batches, which is
+what actually wins: one compile, N cache-warm executions.
+
+Coalescing: consecutive queued flushes whose program fingerprints match
+(identical structure + donation mask + semantic regime) are popped as
+one batch (``RAMBA_SERVE_COALESCE``, default 8, head-only so per-tenant
+FIFO survives) and dispatched back-to-back; each span records
+``coalesced: N`` and a ``serve_coalesce`` event summarizes the batch.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from ramba_tpu.core import fuser as _fuser
+from ramba_tpu.observe import events as _events
+from ramba_tpu.observe import registry as _registry
+from ramba_tpu.serve.fairness import RoundRobin
+
+
+def _coalesce_max() -> int:
+    try:
+        return max(1, int(os.environ.get("RAMBA_SERVE_COALESCE", "8") or 8))
+    except ValueError:
+        return 8
+
+
+class FlushTicket:
+    """Handle to one enqueued flush.  ``wait()`` blocks until dispatch
+    finishes and returns the flush result (the values of ``extra``
+    expressions, usually ``[]``), re-raising the dispatch error if the
+    flush failed — the same exception a synchronous ``flush()`` would
+    have raised, just later."""
+
+    __slots__ = ("stream", "work", "result", "exception", "coalesced",
+                 "_done")
+
+    def __init__(self, stream, work=None):
+        self.stream = stream
+        self.work = work
+        self.result: Optional[list] = None
+        self.exception: Optional[BaseException] = None
+        self.coalesced = 1
+        self._done = threading.Event()
+        if work is None:  # nothing was pending: born finished
+            self.result = []
+            self._done.set()
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def _resolve(self, result) -> None:
+        self.result = result
+        self._done.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        self.exception = exc
+        self._done.set()
+
+    def wait(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("flush ticket not done")
+        if self.exception is not None:
+            raise self.exception
+        return self.result
+
+
+class CompilePipeline:
+    """The background dispatch worker + its fairness queue."""
+
+    def __init__(self, coalesce: Optional[int] = None):
+        self.coalesce = coalesce if coalesce is not None else _coalesce_max()
+        self.queue = RoundRobin()
+        self._worker: Optional[threading.Thread] = None
+        self._start_lock = threading.Lock()
+        self._stopping = False
+        self.dispatched = 0
+        self.batches = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _ensure_worker(self) -> None:
+        if self._worker is not None and self._worker.is_alive():
+            return
+        with self._start_lock:
+            if self._worker is not None and self._worker.is_alive():
+                return
+            self._stopping = False
+            self._worker = threading.Thread(
+                target=self._run, name="ramba-serve-dispatch", daemon=True
+            )
+            self._worker.start()
+
+    def stop(self) -> None:
+        """Drain nothing, stop the worker (tests / interpreter shutdown).
+        Queued tickets are failed so no waiter hangs."""
+        self._stopping = True
+        self.queue.close()
+        w = self._worker
+        if w is not None and w.is_alive():
+            w.join(timeout=5)
+        self._worker = None
+        # fail anything still queued
+        while True:
+            group = self.queue.pop_group(1, timeout=0)
+            if not group:
+                break
+            for t in group:
+                self._finish(t, error=RuntimeError("pipeline stopped"))
+
+    # -- enqueue -----------------------------------------------------------
+
+    def submit(self, stream, extra=()) -> FlushTicket:
+        """Enqueue one flush of ``stream``: detach its pending roots and
+        run the prepare stage on THIS thread, then queue the prepared
+        work for the dispatch worker.  Returns immediately with a
+        ticket.  Prepare errors behave like a synchronous flush's: they
+        raise here (after quarantining the detached roots)."""
+        with stream._flush_lock, _fuser.stream_scope(stream):
+            roots = stream._collect(detach=True)
+            work = _fuser._flush_prepare(stream, roots, list(extra),
+                                         detached=True)
+        if work is None:
+            return FlushTicket(stream)
+        work.enqueued_at = time.perf_counter()
+        ticket = FlushTicket(stream, work)
+        stream.inflight.append(ticket)
+        stream.stats["enqueued"] += 1
+        _registry.inc("serve.enqueued")
+        self.queue.push(stream.tenant or stream.name, ticket)
+        self._ensure_worker()
+        return ticket
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _finish(self, ticket: FlushTicket, result=None, error=None) -> None:
+        try:
+            ticket.stream.inflight.remove(ticket)
+        except ValueError:
+            pass
+        if error is not None:
+            ticket._fail(error)
+        else:
+            ticket._resolve(result)
+
+    def _dispatch_group(self, group: list) -> None:
+        n = len(group)
+        if n > 1:
+            self.batches += 1
+            _registry.inc("serve.coalesced", n)
+            _events.emit({
+                "type": "serve_coalesce",
+                "fingerprint": group[0].work.fingerprint,
+                "n": n,
+                "tenants": sorted({t.stream.tenant or t.stream.name
+                                   for t in group}),
+            })
+        for ticket in group:
+            ticket.coalesced = n
+            work = ticket.work
+            work.span["async"] = True
+            try:
+                with _fuser.stream_scope(work.stream):
+                    result = _fuser._flush_dispatch(work, coalesced=n)
+            except BaseException as e:  # ladder exhausted / fatal
+                self._finish(ticket, error=e)
+                continue
+            self.dispatched += 1
+            self._finish(ticket, result=result)
+
+    def _run(self) -> None:
+        while not self._stopping:
+            group = self.queue.pop_group(
+                self.coalesce,
+                fingerprint_of=lambda t: t.work.fingerprint,
+                timeout=0.5,
+            )
+            if not group:
+                continue
+            self._dispatch_group(group)
+
+
+_pipeline: Optional[CompilePipeline] = None
+_pipeline_lock = threading.Lock()
+
+
+def get_pipeline() -> CompilePipeline:
+    """Process-wide pipeline singleton (all sessions share one worker —
+    see the module docstring for why one is the right number)."""
+    global _pipeline
+    with _pipeline_lock:
+        if _pipeline is None:
+            _pipeline = CompilePipeline()
+        return _pipeline
+
+
+def shutdown() -> None:
+    """Stop the shared pipeline (tests)."""
+    global _pipeline
+    with _pipeline_lock:
+        p, _pipeline = _pipeline, None
+    if p is not None:
+        p.stop()

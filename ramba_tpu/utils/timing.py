@@ -43,17 +43,21 @@ per_func: dict = _registry.per_func
 
 def add_time(name: str, seconds: float) -> None:
     """Accumulate into a top-level timer (reference: add_time,
-    ramba.py:923-940)."""
-    ent = time_dict[name]
-    ent[0] += seconds
-    ent[1] += 1
+    ramba.py:923-940).  Guarded by the registry lock: the two-field
+    update is a read-modify-write that concurrent serving streams would
+    otherwise corrupt."""
+    with _registry.lock:
+        ent = time_dict[name]
+        ent[0] += seconds
+        ent[1] += 1
 
 
 def add_sub_time(parent: str, name: str, seconds: float) -> None:
     """Accumulate into a nested timer (reference: add_sub_time)."""
-    ent = sub_time_dict[(parent, name)]
-    ent[0] += seconds
-    ent[1] += 1
+    with _registry.lock:
+        ent = sub_time_dict[(parent, name)]
+        ent[0] += seconds
+        ent[1] += 1
 
 
 _PER_FUNC_MAX = 1024
@@ -64,11 +68,12 @@ def add_func_time(label: str, seconds: float) -> None:
     ramba.py:3794-3817).  Bounded: beyond _PER_FUNC_MAX distinct labels,
     new ones aggregate under "<other>" so a program generating unbounded
     distinct structures can't grow this dict forever."""
-    if label not in per_func and len(per_func) >= _PER_FUNC_MAX:
-        label = "<other>"
-    ent = per_func[label]
-    ent[0] += seconds
-    ent[1] += 1
+    with _registry.lock:
+        if label not in per_func and len(per_func) >= _PER_FUNC_MAX:
+            label = "<other>"
+        ent = per_func[label]
+        ent[0] += seconds
+        ent[1] += 1
 
 
 @contextmanager
@@ -93,8 +98,9 @@ comm_stats: dict = _registry.comm
 
 
 def note_transfer(direction: str, nbytes: int) -> None:
-    comm_stats[f"{direction}_bytes"] += int(nbytes)
-    comm_stats[f"{direction}_count"] += 1
+    with _registry.lock:
+        comm_stats[f"{direction}_bytes"] += int(nbytes)
+        comm_stats[f"{direction}_count"] += 1
 
 
 def print_comm_stats(file=None) -> None:

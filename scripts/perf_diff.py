@@ -44,6 +44,8 @@ _METRIC_DIRECTION = {
     "hbm_gb_per_s": "higher",
     "hbm_gb_per_s_net": "higher",
     "matmul_tflops": "higher",
+    "serving_flushes_per_s": "higher",
+    "serving_p95_flush_ms": "lower",
 }
 
 

@@ -16,3 +16,5 @@ echo "=== leg 5: 2-process memory governor (tiny RAMBA_HBM_BUDGET) ==="
 python scripts/two_process_suite.py --memory-leg
 echo "=== leg 6: 2-process kernel cost ledger (RAMBA_PERF=1) ==="
 python scripts/two_process_suite.py --perf-leg
+echo "=== leg 7: 2-process serving sessions (async pipeline, coalescing) ==="
+python scripts/two_process_suite.py --serving-leg

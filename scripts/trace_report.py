@@ -135,19 +135,44 @@ def report(path: str, events: list, top: int = 10, file=None) -> None:
         print("rewrite fires: " + "  ".join(
             f"{r}={n}" for r, n in sorted(fires.items())), file=file)
 
-    per = defaultdict(lambda: [0.0, 0, 0.0])  # label -> [wall, count, compile]
+    # serving runs tag spans with the tenant; the table grows a tenant
+    # column (and a per-tenant totals block) only when one is present, so
+    # single-stream traces render exactly as before
+    tenanted = any("tenant" in f for f in flushes)
+    if tenanted:
+        per_tenant = defaultdict(lambda: [0.0, 0, 0])  # [wall, count, queued]
+        for f in flushes:
+            ent = per_tenant[f.get("tenant", "-")]
+            ent[0] += f.get("wall_s", 0.0)
+            ent[1] += 1
+            ent[2] += 1 if "queue_s" in f else 0
+        coalesced = [e for e in events if e.get("type") == "serve_coalesce"]
+        print("per-tenant flush totals:", file=file)
+        for t, (w, cnt, quo) in sorted(per_tenant.items(),
+                                       key=lambda kv: -kv[1][0]):
+            print(f"  {t:<18s} {w:10.4f}s  x{cnt:<5d} async {quo}",
+                  file=file)
+        if coalesced:
+            n = sum(e.get("n", 0) for e in coalesced)
+            print(f"coalesced batches: {len(coalesced)} "
+                  f"({n} flushes merged)", file=file)
+
+    # label -> [wall, count, compile, tenants]
+    per = defaultdict(lambda: [0.0, 0, 0.0, set()])
     for f in flushes:
         ent = per[f.get("label", "?")]
         ent[0] += f.get("wall_s", 0.0)
         ent[1] += 1
         ent[2] += f.get("compile_s", 0.0)
+        if "tenant" in f:
+            ent[3].add(f["tenant"])
     print(f"top {min(top, len(per))} programs by wall time:", file=file)
     ranked = sorted(per.items(), key=lambda kv: -kv[1][0])[:top]
-    for label, (w, cnt, comp) in ranked:
-        print(
-            f"  {label:<18s} {w:10.4f}s  x{cnt:<5d} compile {comp:.4f}s",
-            file=file,
-        )
+    for label, (w, cnt, comp, tenants) in ranked:
+        line = f"  {label:<18s} {w:10.4f}s  x{cnt:<5d} compile {comp:.4f}s"
+        if tenanted:
+            line += f"  tenant {','.join(sorted(tenants)) or '-'}"
+        print(line, file=file)
 
 
 def _findings_summary(events: list, file=None) -> None:
@@ -345,8 +370,14 @@ def _merge_line(e: dict) -> str:
     if t == "cache_evict":
         return f"cache_evict {e.get('key', '?')}"
     if t == "flush_error":
-        return (f"flush_err {e.get('label', '?')}"
-                f" {str(e.get('error', ''))[:60]}")
+        line = f"flush_err {e.get('label', '?')}"
+        if e.get("tenant"):
+            line += f" tenant={e['tenant']}"
+        return line + f" {str(e.get('error', ''))[:60]}"
+    if t == "serve_coalesce":
+        return (f"coalesce  fp={e.get('fingerprint', '?')}"
+                f" n={e.get('n', '?')}"
+                f" tenants={','.join(e.get('tenants') or [])}")
     if t == "memory":
         return (f"memory    {e.get('action', '?')}"
                 f" {_fmt_bytes(e.get('bytes', e.get('over_bytes', 0)) or 0)}")
@@ -395,7 +426,7 @@ def merge_report(path: str, per_rank: dict, file=None, cap: int = 80) -> None:
     def noteworthy(e: dict) -> bool:
         t = e.get("type")
         if t in ("fault", "degrade", "slow_flush", "cache_evict",
-                 "flush_error", "health"):
+                 "flush_error", "health", "serve_coalesce"):
             return True
         if t == "memory":
             return not (e.get("action") == "admit" and e.get("ok"))

@@ -47,6 +47,16 @@ donation + semantic regime, so any rank skew here means the ranks
 compiled different programs — and then runs
 ``scripts/trace_report.py --merge-ranks`` over the per-rank traces to
 prove the cross-rank merged timeline works end to end.
+
+``--serving-leg`` runs the serving-subsystem acceptance leg: each rank
+drives a ``serve.Session`` through the async pipeline's staging seam in
+SINGLE-THREADED deterministic order (the background worker is disabled
+and dispatch is driven inline — SPMD ranks must dispatch identical
+program sequences, so the fairness queue's cross-tenant coalescing
+reorder is off the table here).  Four identical flushes must coalesce
+into ONE fingerprint-matched batch on both ranks; the runner asserts
+the coalesced fingerprint AND the full kernel-ledger key sets are
+identical across ranks.
 """
 
 from __future__ import annotations
@@ -150,6 +160,159 @@ execs = sum(k['exec']['count'] for k in rep['kernels'].values())
 assert execs >= 1, rep
 print('PERF_LEG_KEYS rank=%d %s' % (rank, ','.join(keys)))
 """
+
+
+# SPMD workload for the serving leg: each rank opens one serving session
+# and pushes four structurally-identical flushes plus one distinct one
+# through the async pipeline's enqueue/dispatch seam, driving dispatch
+# inline (worker disabled) so both ranks execute the identical program
+# sequence.  argv: <rank> <coordinator>.
+_SERVING_WORKLOAD = """
+import sys
+import numpy as np
+rank, coord = int(sys.argv[1]), sys.argv[2]
+from ramba_tpu.parallel import distributed
+distributed.initialize(coordinator_address=coord, num_processes=2,
+                       process_id=rank)
+import jax
+assert jax.process_count() == 2, jax.process_count()
+import ramba_tpu as rt
+from ramba_tpu import diagnostics, serve
+from ramba_tpu.serve.pipeline import CompilePipeline
+pipe = CompilePipeline(coalesce=8)
+pipe._ensure_worker = lambda: None  # deterministic: dispatch inline below
+with serve.Session(tenant='spmd', pipeline=pipe) as s:
+    arrs, tickets = [], []
+    for i in range(4):
+        arrs.append(rt.arange(8192) * 2.0 + 1.0)
+        tickets.append(s.flush())
+    group = pipe.queue.pop_group(
+        8, fingerprint_of=lambda t: t.work.fingerprint, timeout=0)
+    assert len(group) == 4, len(group)
+    fp = group[0].work.fingerprint
+    pipe._dispatch_group(group)
+    for t in tickets:
+        assert t.wait(timeout=120) == [] and t.coalesced == 4
+    exp = np.arange(8192) * 2.0 + 1.0
+    for a in arrs:
+        got = np.asarray(a)
+        assert np.allclose(got, exp), got[:4]
+    b = rt.sqrt(rt.arange(4096) + 1.0)
+    t2 = s.flush()
+    g2 = pipe.queue.pop_group(
+        8, fingerprint_of=lambda t: t.work.fingerprint, timeout=0)
+    assert len(g2) == 1, len(g2)
+    pipe._dispatch_group(g2)
+    t2.wait(timeout=120)
+    assert np.allclose(np.asarray(b), np.sqrt(np.arange(4096) + 1.0))
+pipe.stop()
+rep = serve.tenant_report()
+assert rep['spmd']['flushes'] >= 5, rep
+assert rep['spmd']['quota_rejects'] == 0, rep
+from ramba_tpu.observe import ledger
+keys = ledger.kernel_keys()
+assert keys, 'empty kernel ledger'
+print('SERVING_LEG_COALESCE rank=%d fp=%s' % (rank, fp))
+print('SERVING_LEG_KEYS rank=%d %s' % (rank, ','.join(sorted(keys))))
+"""
+
+
+def run_serving_leg() -> int:
+    """Two ranks drive serving sessions in deterministic lockstep; the
+    coalesced-batch fingerprint and the full kernel-key sets must be
+    identical across ranks."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    basetemp = tempfile.mkdtemp(prefix="ramba_2proc_serve_")
+    trace_base = os.path.join(basetemp, "trace.jsonl")
+    budget = float(os.environ.get("RAMBA_TEST_PROCS_TIMEOUT", "600"))
+
+    procs, logs = [], []
+    for rank in range(2):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        for k in ("RAMBA_TEST_PROCS", "RAMBA_TEST_PROC_ID",
+                  "RAMBA_TEST_COORD", "RAMBA_TEST_SHARED_TMP",
+                  "RAMBA_PROFILE_DIR", "RAMBA_FAULTS", "RAMBA_HBM_BUDGET"):
+            env.pop(k, None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["RAMBA_TRACE"] = trace_base
+        log = open(os.path.join(basetemp, f"rank{rank}.log"), "w")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _SERVING_WORKLOAD, str(rank),
+             f"localhost:{port}"],
+            env=env, stdout=log, stderr=subprocess.STDOUT, cwd=REPO,
+        ))
+
+    deadline = time.time() + budget
+    rcs = [None, None]
+    try:
+        for i, p in enumerate(procs):
+            left = max(5.0, deadline - time.time())
+            try:
+                rcs[i] = p.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                rcs[i] = -9
+    finally:
+        for log in logs:
+            log.close()
+
+    ok = all(rc == 0 for rc in rcs)
+
+    # Both ranks must agree on the coalesced-program fingerprint AND the
+    # full kernel-key set — SPMD serving means identical dispatch.
+    marks = {"SERVING_LEG_COALESCE": [None, None],
+             "SERVING_LEG_KEYS": [None, None]}
+    for rank in range(2):
+        path = os.path.join(basetemp, f"rank{rank}.log")
+        with open(path) as f:
+            tail = f.read().splitlines()
+        for line in tail:
+            for mark in marks:
+                if line.startswith(f"{mark} rank={rank} "):
+                    marks[mark][rank] = line.split(" ", 2)[2]
+        if any(marks[m][rank] is None for m in marks):
+            ok = False
+        print(f"--- serving leg rank {rank} rc={rcs[rank]} ({path}) ---")
+        print("\n".join(tail[-(4 if ok else 40):]))
+    for mark, (r0, r1) in marks.items():
+        if ok and r0 != r1:
+            print(f"serving leg: FAIL ({mark} diverges: r0={r0} r1={r1})")
+            ok = False
+    if ok:
+        nkeys = len((marks["SERVING_LEG_KEYS"][0] or "").split(","))
+        print(f"serving leg: coalesced {marks['SERVING_LEG_COALESCE'][0]}, "
+              f"{nkeys} kernel keys, identical on both ranks")
+
+    # The per-rank traces must carry the tenant-tagged serving events.
+    import json
+
+    for rank in range(2):
+        path = f"{trace_base}.rank{rank}"
+        try:
+            with open(path) as f:
+                evs = [json.loads(ln) for ln in f if ln.strip()]
+            n_co = sum(1 for e in evs if e.get("type") == "serve_coalesce")
+            n_tenant = sum(1 for e in evs if e.get("type") == "flush"
+                           and e.get("tenant") == "spmd")
+            print(f"serving leg rank {rank}: {len(evs)} events, "
+                  f"{n_co} coalesce, {n_tenant} tenant-tagged flushes")
+            if n_co == 0 or n_tenant == 0:
+                print(f"serving leg rank {rank}: FAIL "
+                      f"(coalesce={n_co}, tenant-flushes={n_tenant})")
+                ok = False
+        except (OSError, ValueError) as e:
+            print(f"serving leg rank {rank}: FAIL ({e})")
+            ok = False
+
+    print(f"two-process serving leg: {'OK' if ok else 'FAIL'}")
+    if ok:
+        shutil.rmtree(basetemp, ignore_errors=True)
+    return 0 if ok else 1
 
 
 def run_perf_leg() -> int:
@@ -417,6 +580,8 @@ def main() -> int:
         return run_memory_leg()
     if "--perf-leg" in sys.argv[1:]:
         return run_perf_leg()
+    if "--serving-leg" in sys.argv[1:]:
+        return run_serving_leg()
     pytest_args = sys.argv[1:] or ["tests/"]
     with socket.socket() as s:
         s.bind(("localhost", 0))
