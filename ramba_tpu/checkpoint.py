@@ -55,10 +55,38 @@ _TMP_SUFFIX = ".ramba-tmp"
 
 
 def _barrier(tag: str) -> None:
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
+    # Delegated so cross-rank checkpoint syncs run under the elastic
+    # watchdog deadline (a dead rank -> RankStallError, not a hang).
+    from ramba_tpu.parallel import distributed as _distributed
 
-        multihost_utils.sync_global_devices(tag)
+    _distributed.barrier(tag)
+
+
+def _purge_stale_tmp(apath: str) -> None:
+    """Remove a crashed writer's staging debris before staging again.
+
+    Debris comes in two shapes: the ``<path>.ramba-tmp`` sibling itself
+    (writer died after Orbax finalized the temp but before the rename)
+    and Orbax's own in-progress directories
+    (``<path>.ramba-tmp.orbax-checkpoint-tmp-<ts>`` /
+    ``<path>.orbax-checkpoint-tmp-<ts>``, writer died mid-write).  The
+    latter survive the in-``write()`` purge of the exact tmp path and
+    make the next staged save fail (Orbax refuses the incomplete
+    checkpoint) or leak disk forever.  Rank 0 sweeps every sibling with
+    a matching prefix; all ranks barrier so nobody stages into a
+    directory that is being deleted."""
+    if jax.process_index() == 0:
+        parent, base = os.path.split(apath)
+        tmp_base = base + _TMP_SUFFIX
+        if os.path.isdir(parent):
+            for name in os.listdir(parent):
+                if name == tmp_base or \
+                        name.startswith(tmp_base + ".") or \
+                        name.startswith(base + ".orbax-checkpoint-tmp-"):
+                    victim = os.path.join(parent, name)
+                    shutil.rmtree(victim, ignore_errors=True)
+                    _registry.inc("checkpoint.tmp_purged")
+    _barrier("ramba_ckpt_purge")
 
 
 def save(path: str, tree, *, force: bool = False) -> None:
@@ -83,6 +111,7 @@ def save(path: str, tree, *, force: bool = False) -> None:
         tree,
     )
     tmp = apath + _TMP_SUFFIX
+    _purge_stale_tmp(apath)
 
     def write():
         _faults.check("checkpoint_io", op="save")

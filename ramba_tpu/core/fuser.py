@@ -60,6 +60,7 @@ from ramba_tpu.observe import profile as _profile
 from ramba_tpu.observe import registry as _registry
 from ramba_tpu.parallel import mesh as _mesh
 from ramba_tpu.resilience import degrade as _degrade
+from ramba_tpu.resilience import elastic as _elastic
 from ramba_tpu.resilience import faults as _faults
 from ramba_tpu.resilience import memory as _memory
 from ramba_tpu.resilience.spill import SpilledArray as _SpilledArray
@@ -1036,6 +1037,31 @@ def _execute_resilient(program: _Program, leaf_vals, donate_key: tuple,
                 return False
         return True
 
+    # Elastic watchdog: every rung attempt checks the "dispatch" fault
+    # site (so RAMBA_FAULTS='dispatch:hang:ms=...' can seed a stall) and,
+    # when RAMBA_WATCHDOG_S is armed, runs under a deadline — a hang
+    # becomes a degrade-classified RankStallError, which the ladder
+    # treats like any other failed rung instead of blocking forever.
+    wd = _elastic.watchdog_seconds()
+
+    def _guard(rung_name: str, thunk):
+        def attempt():
+            _faults.check("dispatch", rung=rung_name)
+            if _elastic.cancelled():
+                # the watchdog gave up on this attempt while the fault
+                # check slept; the ladder has moved on — running the rung
+                # now would donate leaf buffers the recovery still owns
+                raise RuntimeError(
+                    f"abandoned {rung_name} attempt after watchdog stall")
+            return thunk()
+
+        if wd is None:
+            return attempt
+        return lambda: _elastic.with_deadline("dispatch", attempt,
+                                              timeout_s=wd)
+
+    rungs = [(name, _guard(name, fn)) for name, fn in rungs]
+
     return _degrade.run_ladder("flush", rungs, leaf_check=leaves_alive,
                                tags=tags)
 
@@ -1404,6 +1430,7 @@ def _flush_dispatch(work: "_FlushWork", *, coalesced: int = 0) -> list:
     # rolling history and emits at most one slow_flush event (after the
     # span, so the trace reads cause-then-verdict).
     _ledger.observe_flush(span)
+    _elastic.note_progress("flush")
     return list(outs[len(roots):])
 
 

@@ -78,6 +78,20 @@ def serving_report() -> dict:
     return _serve.tenant_report()
 
 
+def elastic_report() -> dict:
+    """Job-lifecycle rollup (resilience.elastic): watchdog arming,
+    heartbeat liveness, stall / checkpoint / drain / resume counts."""
+    from ramba_tpu.resilience import elastic as _elastic
+
+    return _elastic.report()
+
+
+def lifecycle_events(n: int = 20) -> list:
+    """Newest-last elastic lifecycle timeline — heartbeats excluded
+    (they are volume); stalls, drains, checkpoints, resumes included."""
+    return _events.last(n, type=("stall", "lifecycle"))
+
+
 def snapshot() -> dict:
     """Everything, JSON-serializable: registry stores + the event ring."""
     snap = _registry.snapshot()
@@ -87,6 +101,7 @@ def snapshot() -> dict:
     serving = serving_report()
     if serving:
         snap["serving"] = serving
+    snap["elastic"] = elastic_report()
     return snap
 
 
@@ -175,6 +190,25 @@ def report(file=None) -> None:
                 f" quota_rejects={row['quota_rejects']}",
                 file=file,
             )
+    el = elastic_report()
+    lc = lifecycle_events()
+    if (el["heartbeat_running"] or el["stalls"] or el["checkpoints"]
+            or el["resumes"] or el["drains"] or lc):
+        print("-- elastic lifecycle --", file=file)
+        print(
+            f"  watchdog_s={el['watchdog_s']}"
+            f" heartbeat={'on' if el['heartbeat_running'] else 'off'}"
+            f" beats={el['heartbeats']}"
+            f" stalls={el['stalls']} drains={el['drains']}"
+            f" checkpoints={el['checkpoints']} resumes={el['resumes']}",
+            file=file,
+        )
+        for ev in lc:
+            bits = [f"{k}={ev[k]}" for k in
+                    ("site", "phase", "step", "waited_s", "classification",
+                     "age_s", "freed_bytes", "wall_s")
+                    if ev.get(k) is not None]
+            print(f"  {ev.get('type', '?'):<10s}" + " ".join(bits), file=file)
     fl = last_flushes()
     if fl:
         print(f"-- last {len(fl)} flush span(s) --", file=file)

@@ -154,6 +154,22 @@ def initialize(
     )
 
 
+def barrier(tag: str) -> None:
+    """Cross-rank sync point (no-op single-process).  Runs under the
+    elastic watchdog deadline: a rank that never arrives (crashed,
+    wedged collective) turns the infinite block into a fatal-classified
+    ``RankStallError`` on the ranks still alive — the signal the
+    drain/resume runbook (docs/index.md) keys on."""
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    from ramba_tpu.resilience import elastic as _elastic
+
+    _elastic.with_deadline(
+        "barrier", lambda: multihost_utils.sync_global_devices(tag))
+
+
 def note_transfer(kind: str, nbytes: int) -> None:
     """Account one cross-process transfer in the observability registry
     (kind: "allgather" | "broadcast" | ...).  Call sites: ndarray.asarray's

@@ -24,6 +24,12 @@ turns those signals into recovery instead of a crash:
   XLA can OOM), and LRU host spill with transparent restore-on-touch.
 * ``spill``   — the host-spill primitives the governor uses
   (``SpilledArray`` wrapper + device_get/device_put round-trip).
+* ``elastic`` — the job lifecycle layer on top of all of the above:
+  per-rank heartbeat beacons, a watchdog deadline around flush dispatch
+  and cross-rank barriers (``RAMBA_WATCHDOG_S`` → classified
+  ``RankStallError``), step-numbered auto-checkpoints with retention-K
+  GC (``CheckpointManager``), drain-to-checkpoint, and mesh-reshape
+  resume into a different rank count.
 
 Everything here is transparent when nothing fails: with ``RAMBA_FAULTS``
 unset and no real errors, zero ``resilience.*`` counters fire and the
@@ -33,6 +39,8 @@ spills, or transfers anything.
 """
 
 from ramba_tpu.resilience import degrade, faults, memory, retry, spill  # noqa: F401
+from ramba_tpu.resilience import elastic  # noqa: F401  (after memory: it uses it)
+from ramba_tpu.resilience.elastic import RankStallError  # noqa: F401
 from ramba_tpu.resilience.faults import (  # noqa: F401
     InjectedFault, InjectedResourceExhausted,
 )

@@ -22,10 +22,26 @@ specs.  Modes:
   than failure (a deterministic trigger for the slow-flush sentinel in
   observe/ledger.py): ``RAMBA_FAULTS='execute:delay:ms=200'`` makes
   every flush's execute step 200 ms slower without perturbing results.
+* ``hang:ms=<n>`` like ``delay`` but semantically a *stall*: the check
+  sleeps long enough to trip the elastic watchdog
+  (``resilience.elastic``, ``RAMBA_WATCHDOG_S``) and then proceeds.
+  The sleep is the hang; the watchdog converts it into a classified
+  :class:`~ramba_tpu.resilience.elastic.RankStallError` in the caller.
+
+``delay`` and ``hang`` accept an optional ``after=<k>`` *payload* (not
+to be confused with the ``after=N`` raising *mode*): the first ``k``
+checks pass untouched and the sleep fires exactly once, on check
+``k+1`` — a deterministic single mid-run stall.  Without the payload
+they fire on every check.  ``dispatch:hang:ms=500:after=2`` hangs the
+third dispatch only, which is how the watchdog and heartbeat-miss
+tests seed a stall without flaky timing.
 
 Sites are free-form strings; the ones wired into the codebase are
 ``compile``, ``execute``, ``oom``, ``eager``, ``host``, ``rewrite``,
-``checkpoint_io``, ``fileio``, ``init_connect``, and ``donate_census``
+``checkpoint_io``, ``fileio``, ``init_connect``, ``dispatch`` (checked
+at the top of every degradation-ladder rung attempt — the seam the
+elastic watchdog wraps), ``heartbeat`` (checked before each liveness
+beacon, so a seeded hang delays a beat), and ``donate_census``
 (which does not fail the flush: it corrupts the buffer-donation mask so
 the RAMBA_VERIFY donation-hazard rule has a real violation to catch).  The ``oom`` site (or a
 trailing ``:oom`` kind) raises :class:`InjectedResourceExhausted`, whose
@@ -94,20 +110,22 @@ class InjectedFatalFault(InjectedFault):
 
 class _Spec:
     __slots__ = ("site", "mode", "kind", "n", "p", "nbytes", "delay_ms",
-                 "calls", "fired")
+                 "after_n", "calls", "fired")
 
     def __init__(self, site: str, mode: str, kind: str,
                  n: Optional[int] = None, p: Optional[float] = None,
                  nbytes: Optional[int] = None,
-                 delay_ms: Optional[float] = None):
+                 delay_ms: Optional[float] = None,
+                 after_n: Optional[int] = None):
         self.site = site
-        # "once" | "always" | "count" | "after" | "prob" | "delay"
+        # "once" | "always" | "count" | "after" | "prob" | "delay" | "hang"
         self.mode = mode
-        self.kind = kind      # "transient" | "oom" | "fatal" | "delay"
+        self.kind = kind      # "transient" | "oom" | "fatal" | "delay" | "hang"
         self.n = n
         self.p = p
         self.nbytes = nbytes  # simulated allocation size for oom kinds
-        self.delay_ms = delay_ms  # sleep length for delay mode
+        self.delay_ms = delay_ms  # sleep length for delay/hang modes
+        self.after_n = after_n    # one-shot trigger for delay/hang modes
         self.calls = 0
         self.fired = 0
 
@@ -126,9 +144,22 @@ def _parse_one(chunk: str) -> _Spec:
     kind = ""
     nbytes: Optional[int] = None
     delay_ms: Optional[float] = None
+    after_n: Optional[int] = None
     for extra in parts[2:]:
         extra = extra.strip().lower()
-        if extra.startswith("ms="):
+        if extra.startswith("after="):
+            if after_n is not None:
+                raise ValueError(
+                    f"bad RAMBA_FAULTS spec {chunk!r}: duplicate after=")
+            try:
+                after_n = int(extra[len("after="):])
+            except ValueError:
+                raise ValueError(
+                    f"bad RAMBA_FAULTS after= payload in {chunk!r}") from None
+            if after_n < 0:
+                raise ValueError(
+                    f"negative RAMBA_FAULTS after= payload in {chunk!r}")
+        elif extra.startswith("ms="):
             if delay_ms is not None:
                 raise ValueError(
                     f"bad RAMBA_FAULTS spec {chunk!r}: duplicate ms=")
@@ -156,18 +187,25 @@ def _parse_one(chunk: str) -> _Spec:
                 f"bad RAMBA_FAULTS spec {chunk!r}: too many fields")
     if kind not in ("", "oom", "fatal", "transient"):
         raise ValueError(f"bad RAMBA_FAULTS kind {kind!r} in {chunk!r}")
-    if mode == "delay":
-        # slowness, not failure: fires every check, sleeps, never raises
+    if mode in ("delay", "hang"):
+        # slowness/stall, not failure: sleeps, never raises.  With an
+        # after=<k> payload the sleep fires exactly once (on check k+1);
+        # without it, on every check.
         if kind:
             raise ValueError(
-                f"bad RAMBA_FAULTS spec {chunk!r}: delay takes no kind")
+                f"bad RAMBA_FAULTS spec {chunk!r}: {mode} takes no kind")
         if delay_ms is None:
             raise ValueError(
-                f"bad RAMBA_FAULTS spec {chunk!r}: delay needs ms=<n>")
-        return _Spec(site, "delay", "delay", delay_ms=delay_ms)
+                f"bad RAMBA_FAULTS spec {chunk!r}: {mode} needs ms=<n>")
+        return _Spec(site, mode, mode, delay_ms=delay_ms, after_n=after_n)
     if delay_ms is not None:
         raise ValueError(
-            f"bad RAMBA_FAULTS spec {chunk!r}: ms= only valid with delay")
+            f"bad RAMBA_FAULTS spec {chunk!r}: ms= only valid with "
+            f"delay/hang")
+    if after_n is not None:
+        raise ValueError(
+            f"bad RAMBA_FAULTS spec {chunk!r}: after= payload only valid "
+            f"with delay/hang (use the after=N mode for raising faults)")
     if not kind:
         kind = "oom" if site == "oom" else "transient"
     if mode == "once":
@@ -248,7 +286,12 @@ def stats() -> Dict[str, dict]:
 def _should_fire(sp: _Spec) -> bool:
     if sp.mode == "once":
         return sp.fired == 0
-    if sp.mode in ("always", "delay"):
+    if sp.mode in ("delay", "hang"):
+        if sp.after_n is None:
+            return True
+        # one-shot: checks 1..k pass, check k+1 sleeps, later checks pass
+        return sp.calls == sp.after_n + 1
+    if sp.mode == "always":
         return True
     if sp.mode == "count":
         return sp.fired < (sp.n or 0)
@@ -291,7 +334,7 @@ def check(site: str, **ctx) -> None:
         ev["ms"] = delay_ms
     ev.update(ctx)
     _events.emit(ev)
-    if kind == "delay":
+    if kind in ("delay", "hang"):
         import time
 
         time.sleep((delay_ms or 0.0) / 1000.0)

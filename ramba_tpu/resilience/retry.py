@@ -74,6 +74,12 @@ def classify(exc: BaseException) -> str:
     ``"fatal"`` (propagate unchanged)."""
     if isinstance(exc, RetryBudgetExhausted):
         return "degrade"
+    # Watchdog stalls (elastic.RankStallError) carry their routing with
+    # them — duck-typed on the attribute so this module needs no elastic
+    # import (elastic imports retry's sibling modules).
+    stall = getattr(exc, "stall_classification", None)
+    if stall in ("retryable", "degrade", "fatal"):
+        return stall
     if isinstance(exc, _faults.InjectedResourceExhausted):
         return "oom"
     if isinstance(exc, _faults.InjectedFault):

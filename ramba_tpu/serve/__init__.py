@@ -39,13 +39,24 @@ from __future__ import annotations
 
 from ramba_tpu.serve.fairness import RoundRobin
 from ramba_tpu.serve.pipeline import (CompilePipeline, FlushTicket,
-                                      get_pipeline, shutdown)
+                                      current_pipeline, get_pipeline,
+                                      shutdown)
 from ramba_tpu.serve.session import Session
 
 __all__ = [
     "Session", "CompilePipeline", "FlushTicket", "RoundRobin",
-    "get_pipeline", "shutdown", "tenant_report",
+    "current_pipeline", "get_pipeline", "shutdown", "quiesce",
+    "tenant_report",
 ]
+
+
+def quiesce() -> int:
+    """Flush + drain every session's stream and the async pipeline's
+    queue — the serve-facing name for ``resilience.elastic.quiesce``,
+    which drain-to-checkpoint runs before saving."""
+    from ramba_tpu.resilience import elastic as _elastic
+
+    return _elastic.quiesce()
 
 
 def tenant_report() -> dict:

@@ -115,6 +115,20 @@ class CompilePipeline:
             )
             self._worker.start()
 
+    def quiesce(self, timeout: Optional[float] = None) -> bool:
+        """Wait until the fairness queue is empty (drain-to-checkpoint's
+        first step).  Popped-but-unfinished work is covered by the stream
+        drains that follow (``fuser.sync`` waits out every inflight
+        ticket); this only has to outlast the queue backlog.  Returns
+        False on timeout instead of raising — the caller's drain
+        deadline decides what a stuck queue means."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while len(self.queue) > 0:
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(0.005)
+        return True
+
     def stop(self) -> None:
         """Drain nothing, stop the worker (tests / interpreter shutdown).
         Queued tickets are failed so no waiter hangs."""
@@ -216,6 +230,13 @@ def get_pipeline() -> CompilePipeline:
         if _pipeline is None:
             _pipeline = CompilePipeline()
         return _pipeline
+
+
+def current_pipeline() -> Optional[CompilePipeline]:
+    """The live pipeline if one exists — unlike :func:`get_pipeline`,
+    never creates one (elastic drain must not spin up a worker just to
+    quiesce it)."""
+    return _pipeline
 
 
 def shutdown() -> None:

@@ -18,3 +18,5 @@ echo "=== leg 6: 2-process kernel cost ledger (RAMBA_PERF=1) ==="
 python scripts/two_process_suite.py --perf-leg
 echo "=== leg 7: 2-process serving sessions (async pipeline, coalescing) ==="
 python scripts/two_process_suite.py --serving-leg
+echo "=== leg 8: elastic lifecycle (2-rank checkpoint, 1-rank resume) ==="
+python scripts/two_process_suite.py --elastic-leg

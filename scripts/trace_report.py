@@ -20,6 +20,10 @@ Prints, per input:
   * the memory timeline (admission checks, watermark crossings, spills,
     restores, oom evictions) with a peak-live column in the flush
     totals,
+  * the elastic lifecycle timeline (watchdog stalls, drains,
+    checkpoints, resumes, heartbeat misses) plus a per-rank heartbeat
+    liveness summary that flags gaps wider than 2x the beacon interval
+    — the offline signature of a wedged rank,
   * slow_flush sentinel events (observe/ledger.py), and
   * the top programs by cumulative wall time.
 
@@ -87,6 +91,7 @@ def report(path: str, events: list, top: int = 10, file=None) -> None:
 
     _degradation_timeline(events, file=file)
     _memory_timeline(events, file=file)
+    _lifecycle_timeline(events, file=file)
     _findings_summary(events, file=file)
     _slow_flush_summary(events, file=file)
 
@@ -320,6 +325,74 @@ def _memory_timeline(events: list, file=None, cap: int = 50) -> None:
           f"rejects={rejects}", file=file)
 
 
+def _lifecycle_timeline(events: list, file=None, cap: int = 40) -> None:
+    """Elastic job-lifecycle lines (watchdog stalls, drain / checkpoint /
+    resume phases, heartbeat misses) plus a heartbeat liveness summary.
+
+    Heartbeats themselves are volume (one per RAMBA_HEARTBEAT_S), so
+    they are rolled up rather than listed: beat count, observed beacon
+    span, and every inter-beat gap wider than 2x the interval — a rank
+    that went silent mid-run shows up here as a flagged gap even though
+    no single event says so."""
+    file = file or sys.stdout
+    beats = [e for e in events if e.get("type") == "heartbeat"]
+    life = [e for e in events if e.get("type") in ("stall", "lifecycle")]
+    if not beats and not life:
+        return
+    stamps = [e["ts"] for e in events if isinstance(e.get("ts"), (int, float))]
+    t0 = min(stamps) if stamps else None
+
+    def rel(e):
+        return (f"+{e['ts'] - t0:8.3f}s"
+                if t0 is not None and isinstance(e.get("ts"), (int, float))
+                else " " * 10)
+
+    if life:
+        print(f"lifecycle timeline ({len(life)} events):", file=file)
+        for e in life[:cap]:
+            if e["type"] == "stall":
+                line = (f"STALL     {e.get('site', '?')} "
+                        f"waited={e.get('waited_s', '?')}s "
+                        f"deadline={e.get('deadline_s', '?')}s "
+                        f"class={e.get('classification', '?')}")
+            else:
+                phase = e.get("phase", "?")
+                line = f"{phase:<9s}"
+                for k in ("step", "streams", "age_s", "limit_s",
+                          "deleted_steps", "from_processes", "to_processes",
+                          "freed_bytes", "wall_s"):
+                    if e.get(k) is not None:
+                        line += f" {k}={e[k]}"
+            print(f"  {rel(e)}  {line}", file=file)
+        if len(life) > cap:
+            print(f"  ... and {len(life) - cap} more", file=file)
+        stalls = sum(1 for e in life if e["type"] == "stall")
+        misses = sum(1 for e in life if e.get("phase") == "heartbeat_missed")
+        saves = sum(1 for e in life if e.get("phase") == "checkpoint_saved")
+        resumes = sum(1 for e in life if e.get("phase") == "resume_complete")
+        print(f"lifecycle totals: stalls={stalls} heartbeat-misses={misses} "
+              f"checkpoints={saves} resumes={resumes}", file=file)
+
+    if beats:
+        interval = beats[-1].get("interval_s") or 0.0
+        stamped = [e["ts"] for e in beats
+                   if isinstance(e.get("ts"), (int, float))]
+        span = (stamped[-1] - stamped[0]) if len(stamped) > 1 else 0.0
+        print(f"heartbeat: {len(beats)} beats over {span:.3f}s "
+              f"(interval {interval}s)", file=file)
+        limit = 2.0 * interval if interval else None
+        flagged = 0
+        for a, b in zip(stamped, stamped[1:]):
+            gap = b - a
+            if limit is not None and gap > limit:
+                flagged += 1
+                r = (f"+{a - t0:8.3f}s" if t0 is not None else " " * 10)
+                print(f"  {r}  GAP {gap:.3f}s > 2x interval "
+                      f"({limit:.3f}s) — rank silent", file=file)
+        if limit is not None and not flagged:
+            print(f"  no gaps over 2x interval ({limit:.3f}s)", file=file)
+
+
 def _file_rank(path: str, events: list) -> int:
     """Rank of one trace file: the ``.rank<i>`` filename suffix wins,
     else the first event carrying a ``rank`` field, else 0."""
@@ -381,6 +454,15 @@ def _merge_line(e: dict) -> str:
     if t == "memory":
         return (f"memory    {e.get('action', '?')}"
                 f" {_fmt_bytes(e.get('bytes', e.get('over_bytes', 0)) or 0)}")
+    if t == "stall":
+        return (f"STALL     {e.get('site', '?')}"
+                f" waited={e.get('waited_s', '?')}s"
+                f" class={e.get('classification', '?')}")
+    if t == "lifecycle":
+        line = f"lifecycle {e.get('phase', '?')}"
+        if e.get("step") is not None:
+            line += f" step={e['step']}"
+        return line
     if t == "flush":
         return (f"flush     {e.get('label', '?')}"
                 f" rung={e.get('degraded', 'fused')}"
@@ -426,7 +508,8 @@ def merge_report(path: str, per_rank: dict, file=None, cap: int = 80) -> None:
     def noteworthy(e: dict) -> bool:
         t = e.get("type")
         if t in ("fault", "degrade", "slow_flush", "cache_evict",
-                 "flush_error", "health", "serve_coalesce"):
+                 "flush_error", "health", "serve_coalesce", "stall",
+                 "lifecycle"):
             return True
         if t == "memory":
             return not (e.get("action") == "admit" and e.get("ok"))

@@ -48,6 +48,17 @@ compiled different programs — and then runs
 ``scripts/trace_report.py --merge-ranks`` over the per-rank traces to
 prove the cross-rank merged timeline works end to end.
 
+``--elastic-leg`` runs the elastic-lifecycle acceptance leg: a 2-rank
+SPMD run (heartbeat on, watchdog armed) auto-checkpoints mid-workload
+via ``elastic.CheckpointManager.maybe_save`` into a shared directory and
+stops — simulating preemption after the save.  A fresh SINGLE-rank
+process then ``elastic.resume``s from that directory (mesh reshape:
+manifest says 2 processes, the resuming world has 1) and finishes the
+workload; a straight 1-rank run of the full workload provides the
+reference.  The runner asserts the two final-state sha256 digests are
+BYTE-IDENTICAL — the workload is elementwise, so resharding must not
+perturb a single bit.
+
 ``--serving-leg`` runs the serving-subsystem acceptance leg: each rank
 drives a ``serve.Session`` through the async pipeline's staging seam in
 SINGLE-THREADED deterministic order (the background worker is disabled
@@ -215,6 +226,206 @@ assert keys, 'empty kernel ledger'
 print('SERVING_LEG_COALESCE rank=%d fp=%s' % (rank, fp))
 print('SERVING_LEG_KEYS rank=%d %s' % (rank, ','.join(sorted(keys))))
 """
+
+
+# SPMD workload for the elastic leg, phase 1: two ranks run the first
+# half of a deterministic elementwise workload with heartbeat + watchdog
+# on, auto-checkpoint at the cadence step into a SHARED root, and stop —
+# a preemption right after the save.  argv: <rank> <coordinator> <root>.
+_ELASTIC_SPMD_WORKLOAD = """
+import sys
+import numpy as np
+rank, coord, root = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+from ramba_tpu.parallel import distributed
+distributed.initialize(coordinator_address=coord, num_processes=2,
+                       process_id=rank)
+import jax
+assert jax.process_count() == 2, jax.process_count()
+import ramba_tpu as rt
+from ramba_tpu.resilience import elastic
+elastic.start_heartbeat(0.2)
+box = {}
+mgr = elastic.CheckpointManager(root, keep=2, every_steps=2)
+mgr.register('state', lambda: {'x': box['x']})
+box['x'] = rt.arange(8192) * 1.0
+for step in (1, 2, 3):
+    box['x'] = box['x'] * 1.000001 + float(step)
+    if mgr.maybe_save(step):
+        print('ELASTIC_LEG_SAVED rank=%d step=%d' % (rank, step))
+assert mgr.latest() == 2, mgr.all_steps()
+elastic.stop_heartbeat()
+print('ELASTIC_LEG_PHASE1_OK rank=%d beats=%d' % (
+    rank, elastic.report()['heartbeats']))
+"""
+
+
+# Elastic leg, phase 2: a fresh SINGLE-rank world resumes from the
+# 2-rank checkpoint (mesh reshape 2->1) and finishes the workload.
+# argv: <root>.
+_ELASTIC_RESUME_WORKLOAD = """
+import sys
+import hashlib
+import numpy as np
+root = sys.argv[1]
+import jax
+assert jax.process_count() == 1, jax.process_count()
+import ramba_tpu as rt
+from ramba_tpu.resilience import elastic
+res = elastic.resume(root)
+assert res.manifest['process_count'] == 2, res.manifest
+assert res.step == 2, res.step
+x = rt.asarray(np.asarray(res.state['state']['x']))
+for step in (3, 4, 5, 6):
+    x = x * 1.000001 + float(step)
+digest = hashlib.sha256(np.ascontiguousarray(np.asarray(x))
+                        .tobytes()).hexdigest()
+print('ELASTIC_LEG_DIGEST %s' % digest)
+"""
+
+
+# Elastic leg, reference: the same workload end to end in one 1-rank
+# process, no checkpoint in the loop.  argv: none.
+_ELASTIC_REF_WORKLOAD = """
+import hashlib
+import numpy as np
+import ramba_tpu as rt
+x = rt.arange(8192) * 1.0
+for step in (1, 2, 3, 4, 5, 6):
+    x = x * 1.000001 + float(step)
+digest = hashlib.sha256(np.ascontiguousarray(np.asarray(x))
+                        .tobytes()).hexdigest()
+print('ELASTIC_LEG_REF %s' % digest)
+"""
+
+
+def run_elastic_leg() -> int:
+    """2-rank auto-checkpoint mid-workload, then a 1-rank resume (mesh
+    reshape) finishes it; the final state must be byte-identical to a
+    straight 1-rank run."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    basetemp = tempfile.mkdtemp(prefix="ramba_2proc_elastic_")
+    ckpt_root = os.path.join(basetemp, "ckpts")
+    trace_base = os.path.join(basetemp, "trace.jsonl")
+    budget = float(os.environ.get("RAMBA_TEST_PROCS_TIMEOUT", "600"))
+
+    def base_env():
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        for k in ("RAMBA_TEST_PROCS", "RAMBA_TEST_PROC_ID",
+                  "RAMBA_TEST_COORD", "RAMBA_TEST_SHARED_TMP",
+                  "RAMBA_PROFILE_DIR", "RAMBA_FAULTS", "RAMBA_HBM_BUDGET"):
+            env.pop(k, None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["RAMBA_TRACE"] = trace_base
+        # armed but generous: nothing here should stall, and a hang in
+        # the checkpoint barrier must fail the leg instead of wedging CI
+        env["RAMBA_WATCHDOG_S"] = "60"
+        return env
+
+    # --- phase 1: 2-rank run, auto-checkpoint at step 2, stop ---
+    procs, logs = [], []
+    for rank in range(2):
+        log = open(os.path.join(basetemp, f"rank{rank}.log"), "w")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _ELASTIC_SPMD_WORKLOAD, str(rank),
+             f"localhost:{port}", ckpt_root],
+            env=base_env(), stdout=log, stderr=subprocess.STDOUT, cwd=REPO,
+        ))
+    deadline = time.time() + budget
+    rcs = [None, None]
+    try:
+        for i, p in enumerate(procs):
+            left = max(5.0, deadline - time.time())
+            try:
+                rcs[i] = p.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                rcs[i] = -9
+    finally:
+        for log in logs:
+            log.close()
+    ok = all(rc == 0 for rc in rcs)
+    for rank in range(2):
+        path = os.path.join(basetemp, f"rank{rank}.log")
+        with open(path) as f:
+            tail = f.read().splitlines()
+        joined = "\n".join(tail)
+        if (f"ELASTIC_LEG_SAVED rank={rank} step=2" not in joined
+                or f"ELASTIC_LEG_PHASE1_OK rank={rank}" not in joined):
+            ok = False
+        print(f"--- elastic leg phase 1 rank {rank} rc={rcs[rank]} "
+              f"({path}) ---")
+        print("\n".join(tail[-(4 if ok else 40):]))
+
+    # --- phase 2: 1-rank resume finishes; reference runs straight ---
+    digests = {}
+    if ok:
+        for name, code, argv in (
+            ("resume", _ELASTIC_RESUME_WORKLOAD, [ckpt_root]),
+            ("reference", _ELASTIC_REF_WORKLOAD, []),
+        ):
+            env = base_env()
+            r = subprocess.run(
+                [sys.executable, "-c", code, *argv],
+                env=env, capture_output=True, text=True, cwd=REPO,
+                timeout=budget,
+            )
+            print(f"--- elastic leg {name} rc={r.returncode} ---")
+            out = r.stdout.splitlines()
+            print("\n".join(out[-4:]) if r.returncode == 0
+                  else (r.stdout + r.stderr))
+            if r.returncode != 0:
+                ok = False
+                continue
+            for line in out:
+                if line.startswith(("ELASTIC_LEG_DIGEST ",
+                                    "ELASTIC_LEG_REF ")):
+                    digests[name] = line.split(" ", 1)[1].strip()
+            if name not in digests:
+                print(f"elastic leg: FAIL (no digest from {name})")
+                ok = False
+
+    if ok:
+        if digests["resume"] != digests["reference"]:
+            print("elastic leg: FAIL (resume digest "
+                  f"{digests['resume']} != reference "
+                  f"{digests['reference']})")
+            ok = False
+        else:
+            print(f"elastic leg: resume after mesh reshape 2->1 is "
+                  f"byte-identical (sha256 {digests['resume'][:16]}...)")
+
+    # The per-rank traces must carry the lifecycle story: heartbeats and
+    # the checkpoint_saved event from phase 1.
+    import json
+
+    if ok:
+        for rank in range(2):
+            path = f"{trace_base}.rank{rank}"
+            try:
+                with open(path) as f:
+                    evs = [json.loads(ln) for ln in f if ln.strip()]
+                n_beat = sum(1 for e in evs if e.get("type") == "heartbeat")
+                n_saved = sum(1 for e in evs if e.get("type") == "lifecycle"
+                              and e.get("phase") == "checkpoint_saved")
+                print(f"elastic leg rank {rank}: {len(evs)} events, "
+                      f"{n_beat} heartbeats, {n_saved} checkpoint_saved")
+                if n_beat == 0 or n_saved == 0:
+                    print(f"elastic leg rank {rank}: FAIL "
+                          f"(beats={n_beat}, saved={n_saved})")
+                    ok = False
+            except (OSError, ValueError) as e:
+                print(f"elastic leg rank {rank}: FAIL ({e})")
+                ok = False
+
+    print(f"two-process elastic leg: {'OK' if ok else 'FAIL'}")
+    if ok:
+        shutil.rmtree(basetemp, ignore_errors=True)
+    return 0 if ok else 1
 
 
 def run_serving_leg() -> int:
@@ -582,6 +793,8 @@ def main() -> int:
         return run_perf_leg()
     if "--serving-leg" in sys.argv[1:]:
         return run_serving_leg()
+    if "--elastic-leg" in sys.argv[1:]:
+        return run_elastic_leg()
     pytest_args = sys.argv[1:] or ["tests/"]
     with socket.socket() as s:
         s.bind(("localhost", 0))

@@ -1,0 +1,508 @@
+"""Elastic job lifecycle: watchdog, heartbeat, checkpoint manager, resume.
+
+Covers ``ramba_tpu.resilience.elastic`` plus its integrations:
+
+* the ``hang:ms=<n>`` / ``after=<k>`` RAMBA_FAULTS grammar that seeds
+  deterministic stalls,
+* the watchdog deadline around flush dispatch: a seeded dispatch hang
+  raises a classified ``RankStallError`` within 2x ``RAMBA_WATCHDOG_S``
+  and the degradation ladder recovers on the next rung (or propagates,
+  when the classification override says fatal),
+* heartbeat beacons on the event stream + deterministic miss detection,
+* ``CheckpointManager``: step-numbered saves with manifests, retention-K
+  GC that never deletes the newest valid checkpoint, strict ``load``,
+* ``CheckpointCorruptError`` paths: truncated/absent manifest,
+  mesh-shape mismatch without a target, x64-flag mismatch,
+* mesh-reshape ``resume`` (manifest-validated, current-mesh targets,
+  HBM-governor admission) and ``drain_to_checkpoint`` quiescing serve
+  sessions,
+* the ``checkpoint.save`` stale-tmp-debris purge regression.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax as _jax
+import ramba_tpu as rt
+from ramba_tpu.observe import events, registry
+from ramba_tpu.resilience import elastic, faults, retry
+
+_MULTIPROC = _jax.process_count() > 1
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """No leaked fault plans, watchdog arming, or beacons between tests;
+    near-zero retry backoff so retry-path tests stay fast."""
+    monkeypatch.setenv("RAMBA_RETRY_BASE_S", "0.001")
+    monkeypatch.delenv("RAMBA_WATCHDOG_S", raising=False)
+    faults.configure(None)
+    yield
+    elastic.stop_heartbeat()
+    faults.reset()
+
+
+def _ck(tmp_path, name):
+    return str(tmp_path / name)
+
+
+# -- hang:ms / after= fault grammar -----------------------------------------
+
+
+def test_hang_spec_parses():
+    sp = faults._parse_one("dispatch:hang:ms=250:after=2")
+    assert (sp.mode, sp.kind, sp.delay_ms, sp.after_n) == \
+        ("hang", "hang", 250.0, 2)
+    sp = faults._parse_one("x:hang:ms=5")
+    assert sp.after_n is None
+
+
+@pytest.mark.parametrize("bad", [
+    "x:hang",                    # hang needs ms=
+    "x:hang:ms=5:oom",           # hang takes no kind
+    "x:hang:ms=5:after=-1",      # negative trigger
+    "x:hang:ms=5:after=1:after=2",   # duplicate
+    "x:once:after=1",            # after= payload only for delay/hang
+])
+def test_hang_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        faults._parse_one(bad)
+
+
+def test_hang_after_fires_exactly_once():
+    faults.configure("s:hang:ms=60:after=1")
+    durations = []
+    for _ in range(4):
+        t0 = time.monotonic()
+        faults.check("s")  # never raises
+        durations.append(time.monotonic() - t0)
+    # checks 1, 3, 4 pass untouched; check 2 sleeps
+    assert durations[1] > 0.05
+    assert all(d < 0.03 for i, d in enumerate(durations) if i != 1)
+    assert faults.stats()["s"]["fired"] == 1
+
+
+def test_hang_without_after_fires_every_check():
+    faults.configure("s:hang:ms=15")
+    t0 = time.monotonic()
+    faults.check("s")
+    faults.check("s")
+    assert time.monotonic() - t0 > 0.025
+    ev = events.last(2, type="fault")
+    assert ev and ev[-1]["kind"] == "hang" and ev[-1]["ms"] == 15.0
+
+
+# -- watchdog / RankStallError ----------------------------------------------
+
+
+def test_stall_error_classification_routing():
+    for cls in ("retryable", "degrade", "fatal"):
+        assert retry.classify(elastic.RankStallError("s", 0.1, cls)) == cls
+
+
+def test_stall_class_defaults_and_override(monkeypatch):
+    assert elastic.stall_class_for("dispatch") == "degrade"
+    assert elastic.stall_class_for("barrier") == "fatal"
+    assert elastic.stall_class_for("heartbeat") == "retryable"
+    assert elastic.stall_class_for("unknown_site") == "degrade"
+    monkeypatch.setenv("RAMBA_WATCHDOG_CLASS_DISPATCH", "fatal")
+    assert elastic.stall_class_for("dispatch") == "fatal"
+    monkeypatch.setenv("RAMBA_WATCHDOG_CLASS_DISPATCH", "bogus")
+    assert elastic.stall_class_for("dispatch") == "degrade"
+
+
+def test_with_deadline_unarmed_is_plain_call():
+    assert elastic.watchdog_seconds() is None
+    assert elastic.with_deadline("dispatch", lambda: 41 + 1) == 42
+
+
+def test_with_deadline_raises_within_two_deadlines():
+    wd = 0.15
+    t0 = time.monotonic()
+    with pytest.raises(elastic.RankStallError) as ei:
+        elastic.with_deadline("dispatch", lambda: time.sleep(1.0),
+                              timeout_s=wd)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 2 * wd  # the acceptance bound
+    assert ei.value.stall_classification == "degrade"
+    st = events.last(1, type="stall")[-1]
+    assert st["site"] == "dispatch" and st["deadline_s"] == wd
+
+
+def test_with_deadline_propagates_errors_and_results():
+    assert elastic.with_deadline("s", lambda: "ok", timeout_s=5.0) == "ok"
+    with pytest.raises(ZeroDivisionError):
+        elastic.with_deadline("s", lambda: 1 / 0, timeout_s=5.0)
+
+
+@pytest.mark.skipif(_MULTIPROC, reason="single-process timing test")
+def test_seeded_dispatch_hang_degrades_and_recovers(monkeypatch):
+    """The acceptance path: a seeded dispatch hang trips the watchdog
+    (classified degrade), the ladder drops a rung, and the flush still
+    produces the right answer."""
+    wd = 0.25
+    monkeypatch.setenv("RAMBA_WATCHDOG_S", str(wd))
+    faults.configure("dispatch:hang:ms=800:after=0")
+    stalls0 = registry.get("elastic.stalls")
+    a = rt.arange(600) * 2.0 + 1.0
+    got = float(a.sum())
+    assert got == float((np.arange(600) * 2.0 + 1.0).sum())
+    st = events.last(3, type="stall")
+    assert st and st[-1]["classification"] == "degrade"
+    assert st[-1]["waited_s"] <= 2 * wd
+    # >= 1: a cold-cache split compile can legitimately blow the same
+    # deadline and push the ladder one more rung — still a recovery
+    assert registry.get("elastic.stalls") >= stalls0 + 1
+    sp = events.last(1, type="flush")[-1]
+    assert sp.get("degraded") in ("split", "chunked", "eager", "host")
+
+
+@pytest.mark.skipif(_MULTIPROC, reason="single-process timing test")
+def test_seeded_hang_fatal_class_propagates(monkeypatch):
+    monkeypatch.setenv("RAMBA_WATCHDOG_S", "0.3")
+    monkeypatch.setenv("RAMBA_WATCHDOG_CLASS_DISPATCH", "fatal")
+    faults.configure("dispatch:hang:ms=900:after=0")
+    a = rt.arange(100) * 3.0
+    with pytest.raises(elastic.RankStallError):
+        float(a.sum())
+    # the hang was one-shot: the quarantined graph self-heals on re-touch
+    assert float(a.sum()) == float((np.arange(100) * 3.0).sum())
+
+
+@pytest.mark.skipif(_MULTIPROC, reason="single-process timing test")
+def test_abandoned_rung_does_not_consume_buffers(monkeypatch):
+    """A rung the watchdog gave up on must not wake later and donate the
+    leaf buffers the recovery path still owns."""
+    monkeypatch.setenv("RAMBA_WATCHDOG_S", "0.3")
+    faults.configure("dispatch:hang:ms=900:after=0")
+    a = rt.arange(4096) * 1.5  # big enough to be donation-eligible
+    first = float(a.sum())
+    time.sleep(1.2)  # let the abandoned thread wake and (not) run
+    assert float(a.sum()) == first
+
+
+# -- heartbeat ---------------------------------------------------------------
+
+
+def test_heartbeat_beacons_on_event_stream():
+    elastic.start_heartbeat(0.04)
+    time.sleep(0.15)
+    elastic.stop_heartbeat()
+    beats = events.last(20, type="heartbeat")
+    assert len(beats) >= 2
+    assert beats[-1]["n"] > beats[-2]["n"]
+    assert beats[-1]["interval_s"] == 0.04
+
+
+@pytest.mark.skipif(_MULTIPROC, reason="single-process timing test")
+def test_heartbeat_miss_detection_under_seeded_hang():
+    elastic.start_heartbeat(0.04)
+    time.sleep(0.06)  # at least one clean beat
+    assert elastic.check_heartbeat() is True
+    # the NEXT heartbeat check stalls long past 2x the interval
+    faults.configure("heartbeat:hang:ms=600:after=0")
+    time.sleep(0.3)
+    assert elastic.check_heartbeat() is False
+    missed = events.last(5, type="lifecycle")
+    assert any(ev["phase"] == "heartbeat_missed" for ev in missed)
+    assert registry.get("elastic.heartbeat_missed") >= 1
+
+
+def test_check_heartbeat_without_beacon_is_healthy():
+    elastic.stop_heartbeat()
+    assert elastic.check_heartbeat() is True
+    assert elastic.last_beat_age() is None
+
+
+# -- CheckpointManager -------------------------------------------------------
+
+
+def test_manager_save_restore_roundtrip(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    mgr = elastic.CheckpointManager(_ck(tmp_path, "mgr"), keep=3)
+    w = rt.arange(64).reshape(8, 8) * 1.5
+    b = rt.arange(8) * 0.25
+    mgr.register("model", {"w": w, "b": b})
+    d = mgr.save(7)
+    assert os.path.isdir(d) and mgr.latest() == 7
+    man = mgr.manifest(7)
+    assert man["process_count"] == _jax.process_count()
+    assert man["x64"] == bool(_jax.config.jax_enable_x64)
+    assert len(man["leaves"]) == 2
+    shapes = sorted(tuple(lf["shape"]) for lf in man["leaves"])
+    assert shapes == [(8,), (8, 8)]
+    back = mgr.load(7)
+    np.testing.assert_allclose(np.asarray(back["model"]["w"]),
+                               np.arange(64).reshape(8, 8) * 1.5)
+
+
+def test_manager_save_requires_something(tmp_path):
+    mgr = elastic.CheckpointManager(_ck(tmp_path, "mgr0"))
+    with pytest.raises(ValueError, match="nothing to checkpoint"):
+        mgr.save(1)
+
+
+def test_manager_maybe_save_cadence(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    mgr = elastic.CheckpointManager(_ck(tmp_path, "mgrc"), every_steps=3)
+    mgr.register("s", {"x": rt.arange(10) * 1.0})
+    assert mgr.maybe_save(1) is None
+    assert mgr.maybe_save(2) is None
+    assert mgr.maybe_save(3) is not None
+    assert mgr.valid_steps() == [3]
+
+
+def test_manager_retention_gc(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    mgr = elastic.CheckpointManager(_ck(tmp_path, "mgrgc"), keep=2)
+    mgr.register("s", {"x": rt.arange(12) * 1.0})
+    for s in (1, 2, 3, 4):
+        mgr.save(s)
+    assert mgr.all_steps() == [3, 4]
+    assert mgr.latest() == 4
+
+
+def test_manager_gc_never_deletes_newest_valid(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    mgr = elastic.CheckpointManager(_ck(tmp_path, "mgrnv"), keep=5)
+    mgr.register("s", {"x": rt.arange(12) * 1.0})
+    mgr.save(1)
+    mgr.save(2)
+    # tear step 2's manifest: step 1 becomes the newest VALID checkpoint
+    with open(mgr.manifest_path(2), "w") as f:
+        f.write('{"step": 2, "process_')  # truncated mid-key
+    assert mgr.latest() == 1
+    # even the tightest retention must keep the newest valid step
+    tight = elastic.CheckpointManager(mgr.root, keep=1)
+    deleted = tight.gc()
+    assert 1 not in deleted
+    assert os.path.isdir(mgr.step_dir(1)) and mgr.latest() == 1
+    # torn debris NEWER than the newest valid is left for a possible
+    # concurrent writer, not reaped
+    assert os.path.isdir(mgr.step_dir(2))
+
+
+# -- CheckpointCorruptError paths -------------------------------------------
+
+
+def test_manifest_absent_raises(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    from ramba_tpu.checkpoint import CheckpointCorruptError
+
+    mgr = elastic.CheckpointManager(_ck(tmp_path, "mgra"))
+    mgr.register("s", {"x": rt.arange(6) * 1.0})
+    mgr.save(1)
+    os.remove(mgr.manifest_path(1))
+    assert mgr.latest() is None
+    with pytest.raises(CheckpointCorruptError, match="no manifest"):
+        mgr.manifest(1)
+    with pytest.raises(CheckpointCorruptError, match="no valid checkpoint"):
+        elastic.resume(mgr)
+
+
+def test_manifest_truncated_raises(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    from ramba_tpu.checkpoint import CheckpointCorruptError
+
+    mgr = elastic.CheckpointManager(_ck(tmp_path, "mgrt"))
+    mgr.register("s", {"x": rt.arange(6) * 1.0})
+    mgr.save(1)
+    with open(mgr.manifest_path(1), "w") as f:
+        f.write('{"step": 1, "proc')
+    with pytest.raises(CheckpointCorruptError, match="unreadable"):
+        mgr.manifest(1)
+    with pytest.raises(CheckpointCorruptError):
+        mgr.load(1)
+
+
+def test_mesh_shape_mismatch_without_target_raises(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    from ramba_tpu.checkpoint import CheckpointCorruptError
+
+    mgr = elastic.CheckpointManager(_ck(tmp_path, "mgrm"))
+    mgr.register("s", {"x": rt.arange(32) * 1.0})
+    mgr.save(1)
+    man = mgr.manifest(1)
+    man["process_count"] = int(man["process_count"]) + 1
+    man["mesh_devices"] = int(man["mesh_devices"]) * 2
+    with open(mgr.manifest_path(1), "w") as f:
+        json.dump(man, f)
+    with pytest.raises(CheckpointCorruptError, match="elastic.resume"):
+        mgr.load(1)
+    # resume() is exactly the escape hatch: rebuilds the target for the
+    # CURRENT mesh and re-shards
+    res = elastic.resume(mgr)
+    np.testing.assert_allclose(np.asarray(res.state["s"]["x"]),
+                               np.arange(32) * 1.0)
+    assert res.manifest["mesh_devices"] == man["mesh_devices"]
+
+
+def test_x64_flag_mismatch_raises(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    from ramba_tpu.checkpoint import CheckpointCorruptError
+
+    mgr = elastic.CheckpointManager(_ck(tmp_path, "mgrx"))
+    mgr.register("s", {"x": rt.arange(6) * 1.0})
+    mgr.save(1)
+    man = mgr.manifest(1)
+    man["x64"] = not man["x64"]
+    with open(mgr.manifest_path(1), "w") as f:
+        json.dump(man, f)
+    with pytest.raises(CheckpointCorruptError, match="jax_enable_x64"):
+        mgr.load(1)
+    with pytest.raises(CheckpointCorruptError, match="jax_enable_x64"):
+        elastic.resume(mgr)
+
+
+def test_manifest_leaf_count_mismatch_raises(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    from ramba_tpu.checkpoint import CheckpointCorruptError
+
+    mgr = elastic.CheckpointManager(_ck(tmp_path, "mgrl"))
+    mgr.register("s", {"x": rt.arange(6) * 1.0, "y": rt.arange(4) * 1.0})
+    mgr.save(1)
+    man = mgr.manifest(1)
+    man["leaves"] = man["leaves"][:1]
+    with open(mgr.manifest_path(1), "w") as f:
+        json.dump(man, f)
+    with pytest.raises(CheckpointCorruptError, match="leaves"):
+        elastic.resume(mgr)
+
+
+# -- resume ------------------------------------------------------------------
+
+
+def test_resume_picks_newest_valid_step(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    mgr = elastic.CheckpointManager(_ck(tmp_path, "mgrn"), keep=5)
+    x = {"x": rt.arange(16) * 1.0}
+    mgr.register("s", x)
+    mgr.save(3)
+    mgr.register("s", {"x": rt.arange(16) * 2.0})
+    mgr.save(9)
+    res = elastic.resume(mgr)
+    assert res.step == 9
+    np.testing.assert_allclose(np.asarray(res.state["s"]["x"]),
+                               np.arange(16) * 2.0)
+    lc = [ev["phase"] for ev in events.last(10, type="lifecycle")]
+    assert "resume_begin" in lc and "resume_complete" in lc
+
+
+def test_resume_under_hbm_admission_spills(tmp_path, monkeypatch):
+    pytest.importorskip("orbax.checkpoint")
+    from ramba_tpu.resilience import memory
+
+    mgr = elastic.CheckpointManager(_ck(tmp_path, "mgrb"))
+    big = rt.arange(50_000) * 1.0
+    mgr.register("s", {"x": big})
+    mgr.save(1)
+    float(big.sum())  # ensure materialized + in the ledger
+    live = memory.ledger.live_bytes
+    assert live > 0
+    incoming = 50_000 * np.dtype(np.asarray(big).dtype).itemsize
+    # budget so tight the incoming restore must evict resident arrays
+    monkeypatch.setenv("RAMBA_HBM_BUDGET", str(live + incoming // 2))
+    evictions0 = registry.get("memory.evictions")
+    res = elastic.resume(mgr)
+    np.testing.assert_allclose(np.asarray(res.state["s"]["x"]),
+                               np.arange(50_000) * 1.0)
+    assert registry.get("memory.evictions") > evictions0
+    admits = [ev for ev in events.last(10, type="lifecycle")
+              if ev["phase"] == "restore_admit"]
+    assert admits and admits[-1]["freed_bytes"] > 0
+
+
+# -- drain-to-checkpoint -----------------------------------------------------
+
+
+@pytest.mark.skipif(_MULTIPROC, reason="serve sessions are single-process")
+def test_drain_to_checkpoint_quiesces_sessions(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    from ramba_tpu import serve
+
+    root = _ck(tmp_path, "mgrd")
+    with serve.Session(tenant="t0") as s:
+        x = rt.arange(256) * 3.0
+        y = x + 1.0
+        s.flush()  # pending work in flight through the async pipeline
+        d = elastic.drain_to_checkpoint(root, 5, {"y": y})
+    mgr = elastic.CheckpointManager(root)
+    assert mgr.latest() == 5 and os.path.isdir(d)
+    res = elastic.resume(mgr)
+    np.testing.assert_allclose(np.asarray(res.state["y"]),
+                               np.arange(256) * 3.0 + 1.0)
+    phases = [ev["phase"] for ev in events.last(50, type="lifecycle")
+              if ev.get("step") == 5]
+    assert phases[:3] == ["drain_begin", "drain_complete",
+                          "checkpoint_saved"]
+    serve.shutdown()
+
+
+@pytest.mark.skipif(_MULTIPROC, reason="single-process timing test")
+def test_drain_hang_is_fatal_stall(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAMBA_DRAIN_S", "0.1")
+
+    def wedged():
+        time.sleep(1.0)
+
+    monkeypatch.setattr(elastic, "quiesce", wedged)
+    with pytest.raises(elastic.RankStallError) as ei:
+        elastic.drain_to_checkpoint(_ck(tmp_path, "mgrw"), 1, {"x": 1})
+    assert ei.value.stall_classification == "fatal"
+
+
+# -- checkpoint.save stale tmp debris (satellite regression) -----------------
+
+
+def test_save_purges_stale_tmp_siblings(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    from ramba_tpu import checkpoint
+
+    p = _ck(tmp_path, "debris")
+    w = rt.arange(32) * 1.0
+    # a crashed writer's debris, in both shapes: the staged tmp itself
+    # and Orbax's in-progress temp dirs
+    for junk in (p + ".ramba-tmp",
+                 p + ".ramba-tmp.orbax-checkpoint-tmp-123",
+                 p + ".orbax-checkpoint-tmp-456"):
+        os.makedirs(junk, exist_ok=True)
+        with open(os.path.join(junk, "partial"), "w") as f:
+            f.write("torn")
+    purged0 = registry.get("checkpoint.tmp_purged")
+    checkpoint.save(p, {"w": w})
+    for junk in (p + ".ramba-tmp",
+                 p + ".ramba-tmp.orbax-checkpoint-tmp-123",
+                 p + ".orbax-checkpoint-tmp-456"):
+        assert not os.path.exists(junk), junk
+    assert registry.get("checkpoint.tmp_purged") == purged0 + 3
+    back = checkpoint.restore(p)
+    np.testing.assert_allclose(np.asarray(back["w"]), np.arange(32) * 1.0)
+
+
+def test_save_does_not_purge_unrelated_siblings(tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    from ramba_tpu import checkpoint
+
+    p = _ck(tmp_path, "ckpt")
+    other = _ck(tmp_path, "ckpt2.ramba-tmp")  # different base: not debris
+    os.makedirs(other)
+    checkpoint.save(p, {"w": rt.arange(8) * 1.0})
+    assert os.path.isdir(other)
+
+
+# -- diagnostics surface -----------------------------------------------------
+
+
+def test_elastic_report_shape():
+    from ramba_tpu import diagnostics
+
+    rep = diagnostics.elastic_report()
+    for key in ("watchdog_s", "heartbeat_running", "heartbeats", "stalls",
+                "checkpoints", "resumes", "drains"):
+        assert key in rep
+    snap = diagnostics.snapshot()
+    assert "elastic" in snap
