@@ -378,6 +378,70 @@ def _bench_serving(rt, platform):
     }
 
 
+def _bench_observe(rt, platform):
+    """Observability-plane cost section (PAY-FOR-WHAT-YOU-SEE check).
+    Three numbers feed scripts/perf_diff.py: ``observe_events_per_s``
+    (raw emit throughput through the always-on ring — the ceiling every
+    traced subsystem shares), ``observe_flush_overhead_pct`` (wall-clock
+    cost of RAMBA_TRACE JSONL on a flush loop, on vs off — the number
+    that must stay under the 5% budget), and ``observe_scrape_ms`` (one
+    full Prometheus render of every live snapshot — what a scraper
+    actually waits on)."""
+    import os
+    import tempfile
+
+    from ramba_tpu.observe import events as _events
+    from ramba_tpu.observe import telemetry as _telemetry
+
+    out = {}
+
+    # ring throughput: emit-only, no file sink
+    saved_path = _events._trace_path
+    _events.configure(None)
+    n_ev = 20_000
+    t0 = time.perf_counter()
+    for i in range(n_ev):
+        _events.emit({"type": "bench_tick", "i": i})
+    out["observe_events_per_s"] = round(n_ev / (time.perf_counter() - t0))
+
+    # flush overhead: identical flush loop, trace off vs trace on (JSONL
+    # sink + program events).  min-of-5 on both sides strips scheduler
+    # noise (the per-flush tax is ~10us against a ~2ms flush, so the
+    # sample needs to be deep enough not to drown it in jitter).
+    reps, loops = 5, 30 if platform == "cpu" else 24
+    n = 16_384 if platform == "cpu" else 262_144
+
+    def loop():
+        t0 = time.perf_counter()
+        for i in range(loops):
+            a = rt.arange(n) * 2.0 + float(i)
+            a.asarray()
+            del a
+        return time.perf_counter() - t0
+
+    loop()  # warm-up: compile outside every timed window
+    off = min(loop() for _ in range(reps))
+    with tempfile.TemporaryDirectory() as td:
+        _events.configure(os.path.join(td, "bench_trace.jsonl"))
+        try:
+            loop()  # first traced flush opens the sink
+            on = min(loop() for _ in range(reps))
+        finally:
+            _events.configure(saved_path)
+    out["observe_flush_overhead_pct"] = round(100.0 * (on - off) / off, 2)
+
+    # scrape latency: full render of registry + ledger + memory + slo +
+    # elastic (the exporter HTTP handler is this plus socket writes)
+    _telemetry.render()  # warm lazy imports
+    t0 = time.perf_counter()
+    scrapes = 5
+    for _ in range(scrapes):
+        _telemetry.render()
+    out["observe_scrape_ms"] = round(
+        (time.perf_counter() - t0) / scrapes * 1e3, 3)
+    return out
+
+
 def _bench_dispatch_floor(rt):
     """Measured per-dispatch round-trip cost (flush + scalar fetch of a
     tiny computation): on a tunneled chip this floor dominates small
@@ -535,6 +599,11 @@ def main():
             out.update(_bench_serving(rt, platform))
         except Exception:  # noqa: BLE001
             out["serving_error"] = traceback.format_exc(limit=2)[-300:]
+
+        try:
+            out.update(_bench_observe(rt, platform))
+        except Exception:  # noqa: BLE001
+            out["observe_error"] = traceback.format_exc(limit=2)[-300:]
     except Exception:  # noqa: BLE001 - even import/backend failure emits JSON
         out["error"] = traceback.format_exc(limit=3)[-400:]
 

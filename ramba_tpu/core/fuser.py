@@ -58,6 +58,8 @@ from ramba_tpu.observe import events as _events
 from ramba_tpu.observe import ledger as _ledger
 from ramba_tpu.observe import profile as _profile
 from ramba_tpu.observe import registry as _registry
+from ramba_tpu.observe import slo as _slo
+from ramba_tpu.observe import telemetry as _telemetry
 from ramba_tpu.parallel import mesh as _mesh
 from ramba_tpu.resilience import degrade as _degrade
 from ramba_tpu.resilience import elastic as _elastic
@@ -147,8 +149,8 @@ class FlushStream:
 
     __slots__ = ("stream_id", "name", "tenant", "max_pending_ops",
                  "quota_bytes", "on_threshold", "inflight", "stats",
-                 "nodes_since_flush", "_pending", "_lock", "_flush_lock",
-                 "__weakref__")
+                 "nodes_since_flush", "trace_id", "root_span",
+                 "_pending", "_lock", "_flush_lock", "__weakref__")
 
     def __init__(self, name: Optional[str] = None,
                  tenant: Optional[str] = None,
@@ -164,6 +166,10 @@ class FlushStream:
         # hook the serving session installs so threshold auto-flushes go
         # through the async pipeline instead of blocking the build thread
         self.on_threshold = None
+        # causal trace identity (serve.Session mints these): every flush
+        # span of this stream carries trace_id and chains to root_span
+        self.trace_id: Optional[str] = None
+        self.root_span: Optional[str] = None
         # in-flight async work (objects with .wait()); serve/pipeline.py
         # maintains this so drain()/materialization can rendezvous
         self.inflight: list = []
@@ -1295,6 +1301,13 @@ def _flush_prepare(stream: FlushStream, roots: list,
             span["stream"] = stream.name
         if stream.tenant is not None:
             span["tenant"] = stream.tenant
+        if stream.trace_id is not None:
+            # the flush span gets its own span id and chains to the
+            # session root; dispatch re-scopes to it so rung/stall/memory
+            # events become its children
+            span["trace_id"] = stream.trace_id
+            span["span_id"] = _telemetry.mint_id()
+            span["parent_span"] = stream.root_span
         work.program, work.leaves, work.vexprs = program, leaves, vexprs
         work.label, work.span = label, span
 
@@ -1317,8 +1330,13 @@ def _flush_prepare(stream: FlushStream, roots: list,
         span["leaf_bytes"] = leaf_bytes
         span["mem_live_bytes"] = _memory.ledger.live_bytes
         if _events.trace_enabled():
-            _events.emit(_program_event(program, leaves, donate_key, label))
+            pev = _program_event(program, leaves, donate_key, label)
+            if "trace_id" in span:
+                pev.setdefault("trace_id", span["trace_id"])
+                pev.setdefault("parent_span", span["span_id"])
+            _events.emit(pev)
         _profile.ensure_started()
+        _telemetry.ensure_started()
         # In-flight leaves are never spill candidates: admission-triggered
         # (or oom-triggered) eviction during THIS flush must not pull a
         # buffer the program is about to read.
@@ -1363,7 +1381,18 @@ def _flush_dispatch(work: "_FlushWork", *, coalesced: int = 0) -> list:
     """Stage 2 of a flush: admission control, ladder execution, Const
     write-back, span finalization.  Returns the values of the work's
     ``extra`` expressions.  Runs on the caller thread (sync path) or the
-    pipeline's compile worker (async path)."""
+    pipeline's compile worker (async path).
+
+    The whole stage runs inside the flush span's trace scope, so every
+    event emitted underneath — degrade rungs, memory admissions/rejects,
+    watchdog stalls, barrier spans, slow_flush verdicts — is auto-stamped
+    as a child of this flush (observe/telemetry.py)."""
+    span = work.span
+    with _telemetry.span_scope(span.get("trace_id"), span.get("span_id")):
+        return _flush_dispatch_traced(work, coalesced=coalesced)
+
+
+def _flush_dispatch_traced(work: "_FlushWork", *, coalesced: int = 0) -> list:
     stream, span, program = work.stream, work.span, work.program
     roots, label = work.roots, work.label
     if work.enqueued_at is not None:
@@ -1430,6 +1459,7 @@ def _flush_dispatch(work: "_FlushWork", *, coalesced: int = 0) -> list:
     # rolling history and emits at most one slow_flush event (after the
     # span, so the trace reads cause-then-verdict).
     _ledger.observe_flush(span)
+    _slo.observe_span(span)
     _elastic.note_progress("flush")
     return list(outs[len(roots):])
 

@@ -92,15 +92,35 @@ def lifecycle_events(n: int = 20) -> list:
     return _events.last(n, type=("stall", "lifecycle"))
 
 
+def slo_report() -> dict:
+    """Per-tenant latency histogram snapshot + breach state (observe/slo):
+    prepare/dispatch/e2e distributions with p50/p95/p99."""
+    from ramba_tpu.observe import slo as _slo
+
+    return _slo.snapshot()
+
+
 def snapshot() -> dict:
-    """Everything, JSON-serializable: registry stores + the event ring."""
+    """Everything, JSON-serializable: registry stores + the event ring.
+
+    Each section is copied whole under its own lock, and ``captured_at``
+    (+ its monotonic twin) stamps the capture once so exporter scrapes
+    and flight-recorder dumps are attributable to one moment instead of
+    one ambiguous interval."""
+    import time as _time
+
     snap = _registry.snapshot()
-    snap["events"] = list(_events.ring)
+    snap["captured_at"] = round(_time.time(), 6)
+    snap["captured_mono"] = round(_time.monotonic(), 6)
+    snap["events"] = _events.snapshot_ring()
     snap["memory"] = memory_report()
     snap["perf"] = perf_report()
     serving = serving_report()
     if serving:
         snap["serving"] = serving
+    slo = slo_report()
+    if any(slo.get("histograms", {}).values()):
+        snap["slo"] = slo
     snap["elastic"] = elastic_report()
     return snap
 
@@ -233,10 +253,12 @@ def dump(path: str) -> str:
 
 
 def reset() -> None:
-    """Clear counters, timers, the event ring, and the kernel cost ledger
-    (tests/benchmarks)."""
+    """Clear counters, timers, the event ring, the kernel cost ledger,
+    and the SLO histograms (tests/benchmarks)."""
     from ramba_tpu.observe import ledger as _ledger
+    from ramba_tpu.observe import slo as _slo
 
     _registry.reset()
     _events.ring.clear()
     _ledger.reset()
+    _slo.reset()

@@ -9,8 +9,20 @@ fileio's driver-gated saves, without serializing ranks through one fd).
 
 The file is line-buffered JSON-lines: one object per line, so a crashed run
 still yields a parseable prefix (scripts/trace_report.py consumes partial
-files).  Events carry ``ts`` (unix seconds), ``seq`` (per-process monotone),
-and ``rank`` (multi-controller only).
+files).  Events carry ``ts`` (unix seconds), ``mono`` (monotonic seconds —
+immune to NTP steps, the clock cross-rank skew alignment and heartbeat-gap
+math trust), ``seq`` (per-process monotone), and ``rank`` (multi-controller
+only).
+
+Two injection points keep this module import-light while letting the
+telemetry plane (observe/telemetry.py) see every event:
+
+* a **context provider** — called under the emit lock, returns fields
+  (``trace_id``/``parent_span``) to setdefault onto the event, so causal
+  tracing reaches every emitter without any call-site changes;
+* **taps** — callbacks invoked AFTER the lock is released (a tap that
+  blocks, e.g. the flight recorder writing a dump, must not stall
+  concurrent emitters).
 """
 
 from __future__ import annotations
@@ -38,6 +50,32 @@ _trace_path: Optional[str] = os.environ.get("RAMBA_TRACE") or None
 _trace_file = None
 _seq = 0
 _rank: Optional[tuple] = None
+
+# telemetry injection points (see module docstring)
+_context_provider = None
+_taps: list = []
+
+
+def set_context_provider(fn) -> None:
+    """Install the trace-context provider: ``fn() -> Optional[dict]`` of
+    fields to setdefault onto every event.  One provider (last wins)."""
+    global _context_provider
+    _context_provider = fn
+
+
+def add_tap(fn) -> None:
+    """Register ``fn(event)`` to run after every emit, outside the emit
+    lock.  Tap exceptions are swallowed — observers must never take the
+    computation down."""
+    if fn not in _taps:
+        _taps.append(fn)
+
+
+def remove_tap(fn) -> None:
+    try:
+        _taps.remove(fn)
+    except ValueError:
+        pass
 
 
 def trace_enabled() -> bool:
@@ -124,6 +162,15 @@ def emit(event: dict) -> dict:
     with _emit_lock:
         _seq += 1
         event.setdefault("ts", round(time.time(), 6))
+        event.setdefault("mono", round(time.monotonic(), 6))
+        if _context_provider is not None:
+            try:
+                fields = _context_provider()
+            except Exception:
+                fields = None
+            if fields:
+                for k, v in fields.items():
+                    event.setdefault(k, v)
         event["seq"] = _seq
         rank, nprocs = _rank_info() if _trace_path is not None else (None, 1)
         if nprocs > 1:
@@ -134,7 +181,19 @@ def emit(event: dict) -> dict:
                 _file().write(json.dumps(event, default=str) + "\n")
             except OSError:
                 pass
+    for fn in list(_taps):
+        try:
+            fn(event)
+        except Exception:
+            pass
     return event
+
+
+def snapshot_ring() -> list:
+    """One consistent copy of the ring, taken under the emit lock so a
+    scrape or flight dump never interleaves with a concurrent append."""
+    with _emit_lock:
+        return list(ring)
 
 
 def last(n: int = 10, type=None) -> list:

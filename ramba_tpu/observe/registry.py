@@ -33,6 +33,10 @@ lock = threading.RLock()
 # occurrence / byte counters: name -> int
 counters: dict = defaultdict(int)
 
+# names that were last written via gauge() — the metrics exporter types
+# these as Prometheus gauges instead of counters
+_gauge_names: set = set()
+
 # name -> [total_seconds, call_count]  (aliased as timing.time_dict)
 timers: dict = defaultdict(lambda: [0.0, 0])
 # (parent, name) -> [total_seconds, call_count]  (timing.sub_time_dict)
@@ -59,6 +63,13 @@ def gauge(name: str, value) -> None:
     semantics for quantities that go down as well as up."""
     with lock:
         counters[name] = int(value)
+        _gauge_names.add(name)
+
+
+def gauge_names() -> set:
+    """Copy of the names with gauge (last-write-wins) semantics."""
+    with lock:
+        return set(_gauge_names)
 
 
 def get(name: str) -> int:
@@ -89,6 +100,7 @@ def snapshot() -> dict:
 def reset_counters() -> None:
     with lock:
         counters.clear()
+        _gauge_names.clear()
 
 
 def reset_timers() -> None:

@@ -401,6 +401,13 @@ class Ledger:
 
     # -- reporting ----------------------------------------------------------
 
+    def tenant_snapshot(self) -> dict:
+        """Copy of the nonzero per-tenant resident byte counts, taken
+        under the ledger lock — the public read serve.tenant_report()
+        and the metrics exporter use instead of reaching into _lock."""
+        with self._lock:
+            return {t: b for t, b in self.tenant_live.items() if b}
+
     def snapshot(self, top: int = 5) -> dict:
         with self._lock:
             rows = []

@@ -60,11 +60,12 @@ def quiesce() -> int:
 
 
 def tenant_report() -> dict:
-    """Per-tenant rollup across counters, kernel ledger, and the memory
-    ledger — the data behind the serving section of
-    ``diagnostics.report()``."""
+    """Per-tenant rollup across counters, kernel ledger, memory ledger,
+    and the SLO histograms (e2e p50/p95/p99 latency) — the data behind
+    the serving section of ``diagnostics.report()``."""
     from ramba_tpu.observe import ledger as _ledger
     from ramba_tpu.observe import registry as _registry
+    from ramba_tpu.observe import slo as _slo
     from ramba_tpu.resilience import memory as _memory
 
     tenants: dict = {}
@@ -80,13 +81,13 @@ def tenant_report() -> dict:
         if len(parts) < 4:
             continue
         tenant, metric = ".".join(parts[2:-1]), parts[-1]
-        if metric in ("flushes", "nodes", "quota_rejects"):
+        if metric in ("flushes", "nodes", "quota_rejects", "slo_breach"):
             _t(tenant)[metric] = v
     for entry in _ledger.snapshot()["kernels"].values():
         for tenant, n in entry.get("tenants", {}).items():
             _t(tenant)["executes"] += n
-    with _memory.ledger._lock:
-        for tenant, b in _memory.ledger.tenant_live.items():
-            if b:
-                _t(tenant)["live_bytes"] = b
+    for tenant, b in _memory.ledger.tenant_snapshot().items():
+        _t(tenant)["live_bytes"] = b
+    for tenant in list(tenants):
+        tenants[tenant].update(_slo.tenant_latency(tenant))
     return tenants

@@ -38,6 +38,7 @@ from typing import Optional
 from ramba_tpu.core import fuser as _fuser
 from ramba_tpu.observe import events as _events
 from ramba_tpu.observe import registry as _registry
+from ramba_tpu.observe import slo as _slo
 from ramba_tpu.serve.fairness import RoundRobin
 
 
@@ -56,7 +57,7 @@ class FlushTicket:
     have raised, just later."""
 
     __slots__ = ("stream", "work", "result", "exception", "coalesced",
-                 "_done")
+                 "trace_id", "_done")
 
     def __init__(self, stream, work=None):
         self.stream = stream
@@ -64,6 +65,9 @@ class FlushTicket:
         self.result: Optional[list] = None
         self.exception: Optional[BaseException] = None
         self.coalesced = 1
+        # the causal trace this flush belongs to (from the prepared span)
+        self.trace_id: Optional[str] = (
+            work.span.get("trace_id") if work is not None else None)
         self._done = threading.Event()
         if work is None:  # nothing was pending: born finished
             self.result = []
@@ -176,6 +180,15 @@ class CompilePipeline:
             ticket.stream.inflight.remove(ticket)
         except ValueError:
             pass
+        # End-to-end ticket latency (enqueue -> resolve/fail, queue time
+        # included) is what a serving caller experiences — the SLO metric.
+        # Failures count too: a timed-out request that errored still
+        # missed its objective.
+        work = ticket.work
+        if work is not None and work.enqueued_at is not None:
+            _slo.observe_e2e(time.perf_counter() - work.enqueued_at,
+                             tenant=ticket.stream.tenant,
+                             trace_id=ticket.trace_id)
         if error is not None:
             ticket._fail(error)
         else:
@@ -186,13 +199,20 @@ class CompilePipeline:
         if n > 1:
             self.batches += 1
             _registry.inc("serve.coalesced", n)
-            _events.emit({
+            ev = {
                 "type": "serve_coalesce",
                 "fingerprint": group[0].work.fingerprint,
                 "n": n,
                 "tenants": sorted({t.stream.tenant or t.stream.name
                                    for t in group}),
-            })
+            }
+            # every trace that rode this batch — a coalesced dispatch is
+            # one causal join point shared by N requests
+            trace_ids = sorted({t.trace_id for t in group
+                                if t.trace_id is not None})
+            if trace_ids:
+                ev["trace_ids"] = trace_ids
+            _events.emit(ev)
         for ticket in group:
             ticket.coalesced = n
             work = ticket.work

@@ -41,6 +41,8 @@ from typing import Optional
 
 from ramba_tpu import common as _common
 from ramba_tpu.core import fuser as _fuser
+from ramba_tpu.observe import events as _events
+from ramba_tpu.observe import telemetry as _telemetry
 from ramba_tpu.serve import pipeline as _pipeline
 
 
@@ -83,9 +85,16 @@ class Session:
                  name: Optional[str] = None,
                  max_pending: Optional[int] = None,
                  quota=None,
-                 pipeline: Optional["_pipeline.CompilePipeline"] = None):
+                 pipeline: Optional["_pipeline.CompilePipeline"] = None,
+                 trace_id: Optional[str] = None):
         self.tenant = tenant
         self.pipeline = pipeline or _pipeline.get_pipeline()
+        # causal trace root: every flush span of this session chains back
+        # here.  Caller-supplied trace_id joins an existing distributed
+        # trace (the SPMD suite passes one shared id to all ranks);
+        # default is a fresh id per session.
+        self.trace_id = trace_id or _telemetry.mint_id()
+        self.root_span = _telemetry.mint_id()
         self.stream = _fuser.FlushStream(
             name=name or (f"session:{tenant}" if tenant else None),
             tenant=tenant,
@@ -93,11 +102,18 @@ class Session:
                              else _env_max_pending()),
             quota_bytes=_parse_quota(quota),
         )
+        self.stream.trace_id = self.trace_id
+        self.stream.root_span = self.root_span
         # threshold auto-flushes stream through the pipeline instead of
         # blocking the build thread on a synchronous flush
         self.stream.on_threshold = self.pipeline.submit
         self._tokens: list = []
         self.closed = False
+        ev = {"type": "serve_session", "trace_id": self.trace_id,
+              "span_id": self.root_span, "stream": self.stream.name}
+        if tenant is not None:
+            ev["tenant"] = tenant
+        _events.emit(ev)
 
     # -- context management ------------------------------------------------
 

@@ -46,6 +46,9 @@ _METRIC_DIRECTION = {
     "matmul_tflops": "higher",
     "serving_flushes_per_s": "higher",
     "serving_p95_flush_ms": "lower",
+    "observe_events_per_s": "higher",
+    "observe_flush_overhead_pct": "lower",
+    "observe_scrape_ms": "lower",
 }
 
 

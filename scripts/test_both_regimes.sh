@@ -20,3 +20,5 @@ echo "=== leg 7: 2-process serving sessions (async pipeline, coalescing) ==="
 python scripts/two_process_suite.py --serving-leg
 echo "=== leg 8: elastic lifecycle (2-rank checkpoint, 1-rank resume) ==="
 python scripts/two_process_suite.py --elastic-leg
+echo "=== leg 9: live telemetry (2-rank exporters, shared cross-rank trace) ==="
+python scripts/two_process_suite.py --telemetry-leg

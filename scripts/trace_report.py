@@ -375,18 +375,27 @@ def _lifecycle_timeline(events: list, file=None, cap: int = 40) -> None:
 
     if beats:
         interval = beats[-1].get("interval_s") or 0.0
-        stamped = [e["ts"] for e in beats
-                   if isinstance(e.get("ts"), (int, float))]
-        span = (stamped[-1] - stamped[0]) if len(stamped) > 1 else 0.0
+        # Inter-beat gaps use the monotonic clock when every beat carries
+        # one (events gained ``mono`` alongside ``ts``): an NTP step
+        # between two beats would otherwise fabricate — or hide — a gap.
+        # Wall clock only for older traces.
+        if all(isinstance(e.get("mono"), (int, float)) for e in beats):
+            stamped = [(e["mono"], e.get("ts")) for e in beats]
+        else:
+            stamped = [(e["ts"], e["ts"]) for e in beats
+                       if isinstance(e.get("ts"), (int, float))]
+        span = (stamped[-1][0] - stamped[0][0]) if len(stamped) > 1 else 0.0
         print(f"heartbeat: {len(beats)} beats over {span:.3f}s "
               f"(interval {interval}s)", file=file)
         limit = 2.0 * interval if interval else None
         flagged = 0
-        for a, b in zip(stamped, stamped[1:]):
+        for (a, a_ts), (b, _b_ts) in zip(stamped, stamped[1:]):
             gap = b - a
             if limit is not None and gap > limit:
                 flagged += 1
-                r = (f"+{a - t0:8.3f}s" if t0 is not None else " " * 10)
+                r = (f"+{a_ts - t0:8.3f}s"
+                     if t0 is not None and isinstance(a_ts, (int, float))
+                     else " " * 10)
                 print(f"  {r}  GAP {gap:.3f}s > 2x interval "
                       f"({limit:.3f}s) — rank silent", file=file)
         if limit is not None and not flagged:
@@ -408,21 +417,33 @@ def _file_rank(path: str, events: list) -> int:
     return 0
 
 
-def _anchor_ts(events: list):
-    """Per-rank alignment anchor: the distributed bring-up health record
-    is the one event every rank emits at (nearly) the same real moment —
-    the group barrier inside jax.distributed.initialize.  Fallbacks:
-    any health record (mesh bring-up), then the first timestamp."""
+def _anchor(events: list):
+    """Per-rank alignment anchor ``(ts, mono)``: the distributed bring-up
+    health record is the one event every rank emits at (nearly) the same
+    real moment — the group barrier inside jax.distributed.initialize.
+    Fallback: any health record (mesh bring-up).  Returns None when the
+    rank has NO health event at all; the caller must then treat the rank
+    as unanchored (skew 0) rather than misalign it off its first event,
+    whose real-world moment is arbitrary.  ``mono`` rides along so later
+    per-rank deltas can use the monotonic clock (immune to NTP steps);
+    it is None for traces written before events carried ``mono``."""
     for pred in (
         lambda e: e.get("type") == "health"
         and e.get("source") == "distributed_init",
         lambda e: e.get("type") == "health",
-        lambda e: True,
     ):
         for e in events:
             if pred(e) and isinstance(e.get("ts"), (int, float)):
-                return e["ts"]
+                mono = e.get("mono")
+                return (e["ts"],
+                        mono if isinstance(mono, (int, float)) else None)
     return None
+
+
+def _anchor_ts(events: list):
+    """Back-compat shim: the wall-clock half of :func:`_anchor`."""
+    a = _anchor(events)
+    return a[0] if a is not None else None
 
 
 def _merge_line(e: dict) -> str:
@@ -451,6 +472,20 @@ def _merge_line(e: dict) -> str:
         return (f"coalesce  fp={e.get('fingerprint', '?')}"
                 f" n={e.get('n', '?')}"
                 f" tenants={','.join(e.get('tenants') or [])}")
+    if t == "serve_session":
+        line = f"session   stream={e.get('stream', '?')}"
+        if e.get("tenant"):
+            line += f" tenant={e['tenant']}"
+        return line
+    if t == "slo_breach":
+        return (f"SLO-BREACH tenant={e.get('tenant', '-')}"
+                f" p95={e.get('p95_ms', '?')}ms"
+                f" objective={e.get('objective_ms', '?')}ms"
+                f" samples={e.get('samples', '?')}")
+    if t == "program":
+        instrs = e.get("instrs")
+        n = len(instrs) if isinstance(instrs, list) else instrs
+        return f"program   {e.get('label', '?')} instrs={n}"
     if t == "memory":
         return (f"memory    {e.get('action', '?')}"
                 f" {_fmt_bytes(e.get('bytes', e.get('over_bytes', 0)) or 0)}")
@@ -487,21 +522,48 @@ def merge_report(path: str, per_rank: dict, file=None, cap: int = 80) -> None:
     total = sum(len(v) for v in per_rank.values())
     print(f"== merged timeline: {path} ({len(ranks)} rank(s), "
           f"{total} events) ==", file=file)
-    anchors = {r: _anchor_ts(per_rank[r]) for r in ranks}
-    known = [a for a in anchors.values() if a is not None]
+    anchors = {r: _anchor(per_rank[r]) for r in ranks}
+    known = [a[0] for a in anchors.values() if a is not None]
     base = min(known) if known else 0.0
-    skew = {r: (anchors[r] - base if anchors[r] is not None else 0.0)
-            for r in ranks}
+    skew = {}
+    for r in ranks:
+        if anchors[r] is None:
+            # No bring-up anchor in this rank's file (e.g. it crashed
+            # before initialize, or the file is a fragment).  Skew 0 is
+            # honest — any other offset would be invented — but the
+            # timeline reader must know this rank floats.
+            skew[r] = 0.0
+            print(f"rank r{r}: no bring-up anchor event — UNANCHORED "
+                  "(skew 0 assumed, cross-rank ordering approximate)",
+                  file=file)
+        else:
+            skew[r] = anchors[r][0] - base
     print("rank skew (vs earliest anchor): " + "  ".join(
         f"r{r}={skew[r]:+.4f}s" for r in ranks), file=file)
+
+    def _adjusted(r: int, e: dict):
+        """Event time on the common (earliest-anchor) axis.  When both
+        the rank's anchor and the event carry ``mono``, the offset from
+        the anchor uses the monotonic clock — an NTP step between
+        bring-up and the event cannot warp the timeline.  Wall-clock
+        minus skew otherwise."""
+        a = anchors[r]
+        mono = e.get("mono")
+        if (a is not None and a[1] is not None
+                and isinstance(mono, (int, float))):
+            return base + (mono - a[1])
+        ts = e.get("ts")
+        if not isinstance(ts, (int, float)):
+            return None
+        return ts - skew[r]
 
     merged = []
     for r in ranks:
         for e in per_rank[r]:
-            ts = e.get("ts")
-            if not isinstance(ts, (int, float)):
+            adj = _adjusted(r, e)
+            if adj is None:
                 continue
-            merged.append((ts - skew[r], e.get("seq", 0), r, e))
+            merged.append((adj, e.get("seq", 0), r, e))
     merged.sort(key=lambda t: (t[0], t[1], t[2]))
     t0 = merged[0][0] if merged else 0.0
 
@@ -554,6 +616,87 @@ def merge_report(path: str, per_rank: dict, file=None, cap: int = 80) -> None:
               "labels and rungs agree)", file=file)
 
 
+def trace_chain(trace_id: str, per_rank: dict, file=None) -> int:
+    """Reconstruct ONE request's causal chain across ranks.
+
+    Every event stamped with ``trace_id`` (directly, or via the
+    ``trace_ids`` list on a coalesced-batch event) is collected from all
+    rank files and re-threaded by span parentage: the ``serve_session``
+    root, then each flush span in time order, with that span's child
+    events (degrade rungs, stalls, memory admissions, slow_flush
+    verdicts, barrier spans) indented beneath it — the end-to-end story
+    of one request, even when its pieces executed on different ranks and
+    interleaved with thousands of unrelated events."""
+    file = file or sys.stdout
+    evs = []
+    for r in sorted(per_rank):
+        for e in per_rank[r]:
+            if (e.get("trace_id") == trace_id
+                    or trace_id in (e.get("trace_ids") or [])):
+                evs.append((r, e))
+    if not evs:
+        print(f"trace {trace_id}: no events found", file=file)
+        return 1
+
+    def _key(pair):
+        _r, e = pair
+        ts = e.get("ts")
+        return (ts if isinstance(ts, (int, float)) else 0.0,
+                e.get("seq", 0))
+
+    evs.sort(key=_key)
+    ranks = sorted({r for r, _ in evs})
+    stamps = [e.get("ts") for _, e in evs
+              if isinstance(e.get("ts"), (int, float))]
+    t0 = min(stamps) if stamps else None
+
+    def rel(e):
+        ts = e.get("ts")
+        return (f"+{ts - t0:8.3f}s"
+                if t0 is not None and isinstance(ts, (int, float))
+                else " " * 10)
+
+    roots = [(r, e) for r, e in evs if e.get("type") == "serve_session"]
+    spans = [(r, e) for r, e in evs if e.get("type") == "flush"]
+    span_ids = {e.get("span_id") for _, e in spans if e.get("span_id")}
+    children = defaultdict(list)
+    for r, e in evs:
+        if e.get("type") in ("serve_session", "flush"):
+            continue
+        children[e.get("parent_span")].append((r, e))
+
+    print(f"== trace {trace_id}: {len(evs)} events across "
+          f"{len(ranks)} rank(s) {ranks} ==", file=file)
+    for r, e in roots:
+        line = f"session   stream={e.get('stream', '?')}"
+        if e.get("tenant"):
+            line += f" tenant={e['tenant']}"
+        print(f"{rel(e)} r{r}  {line}", file=file)
+    for i, (r, e) in enumerate(spans):
+        line = (f"flush #{i}  {e.get('label', '?')}"
+                f" rung={e.get('degraded', 'fused')}"
+                f" cache={e.get('cache', '?')}")
+        if e.get("queue_s") is not None:
+            line += f" queue={e['queue_s']}s"
+        line += f" wall={e.get('wall_s', 0):.4f}s"
+        if e.get("coalesced"):
+            line += f" coalesced={e['coalesced']}"
+        print(f"{rel(e)} r{r}  {line}", file=file)
+        for cr, c in sorted(children.get(e.get("span_id"), []),
+                            key=lambda p: p[1].get("seq", 0)):
+            print(f"{rel(c)} r{cr}    └ {_merge_line(c)}", file=file)
+    # events parented by the session root (or nothing resolvable): the
+    # slo_breach verdict, coalesce joins, pre-span stalls
+    orphans = [(pid, kids) for pid, kids in children.items()
+               if pid not in span_ids]
+    rest = [p for _pid, kids in orphans for p in kids]
+    if rest:
+        print("session-level events:", file=file)
+        for cr, c in sorted(rest, key=_key):
+            print(f"{rel(c)} r{cr}  {_merge_line(c)}", file=file)
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Summarize RAMBA_TRACE JSONL trace files."
@@ -567,7 +710,27 @@ def main(argv=None) -> int:
                          " timeline and flag rank divergence")
     ap.add_argument("--merge-cap", type=int, default=80,
                     help="max merged timeline lines (default 80)")
+    ap.add_argument("--trace", metavar="ID", default=None,
+                    help="reconstruct one request's causal chain: every"
+                         " event carrying this trace_id, across ranks,"
+                         " threaded session -> flush spans -> rung/stall"
+                         "/memory children")
     args = ap.parse_args(argv)
+
+    if args.trace:
+        rc = 0
+        for p in args.paths:
+            found = _discover(p)
+            if not found:
+                print(f"{p}: no trace file found", file=sys.stderr)
+                return 2
+            per_rank: dict = {}
+            for f in found:
+                evs = _load(f)
+                r = _file_rank(f, evs)
+                per_rank.setdefault(r, []).extend(evs)
+            rc = max(rc, trace_chain(args.trace, per_rank))
+        return rc
 
     if args.merge_ranks:
         for p in args.paths:

@@ -68,6 +68,15 @@ reorder is off the table here).  Four identical flushes must coalesce
 into ONE fingerprint-matched batch on both ranks; the runner asserts
 the coalesced fingerprint AND the full kernel-ledger key sets are
 identical across ranks.
+
+``--telemetry-leg`` runs the live-telemetry acceptance leg: both ranks
+serve a traced ``serve.Session`` flush (one FIXED trace_id shared across
+ranks — the cross-rank causal chain), start the Prometheus exporter on
+an ephemeral port, and scrape their own ``/metrics``.  The runner
+asserts each rank's scrape is labeled with its own distinct
+``rank="<r>"`` and that the shared trace_id landed in BOTH ranks'
+RAMBA_TRACE event files — the inputs ``trace_report.py --trace`` needs
+to reconstruct one request across the fleet.
 """
 
 from __future__ import annotations
@@ -225,6 +234,52 @@ keys = ledger.kernel_keys()
 assert keys, 'empty kernel ledger'
 print('SERVING_LEG_COALESCE rank=%d fp=%s' % (rank, fp))
 print('SERVING_LEG_KEYS rank=%d %s' % (rank, ','.join(sorted(keys))))
+"""
+
+
+# SPMD workload for the telemetry leg: each rank opens a serving session
+# that JOINS one fixed trace_id (the same request fanned out across the
+# fleet), drives a traced flush through the pipeline seam inline, then
+# starts the metrics exporter on an ephemeral port and scrapes itself.
+# argv: <rank> <coordinator> <trace_id>.
+_TELEMETRY_WORKLOAD = """
+import sys
+import urllib.request
+import numpy as np
+rank, coord, trace = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+from ramba_tpu.parallel import distributed
+distributed.initialize(coordinator_address=coord, num_processes=2,
+                       process_id=rank)
+import jax
+assert jax.process_count() == 2, jax.process_count()
+import ramba_tpu as rt
+from ramba_tpu import serve
+from ramba_tpu.observe import telemetry
+from ramba_tpu.serve.pipeline import CompilePipeline
+pipe = CompilePipeline(coalesce=8)
+pipe._ensure_worker = lambda: None  # deterministic: dispatch inline
+with serve.Session(tenant='spmd', pipeline=pipe, trace_id=trace) as s:
+    assert s.trace_id == trace
+    a = rt.arange(8192) * 2.0 + 1.0
+    t = s.flush()
+    g = pipe.queue.pop_group(
+        8, fingerprint_of=lambda t: t.work.fingerprint, timeout=0)
+    assert len(g) == 1, len(g)
+    pipe._dispatch_group(g)
+    assert t.wait(timeout=120) == []
+    assert t.trace_id == trace, t.trace_id
+    assert np.allclose(np.asarray(a), np.arange(8192) * 2.0 + 1.0)
+pipe.stop()
+port = telemetry.start(port=0)
+body = urllib.request.urlopen(
+    'http://127.0.0.1:%d/metrics' % port, timeout=30).read().decode()
+telemetry.stop()
+labels = sorted({ln.split('rank=\"')[1].split('\"')[0]
+                 for ln in body.splitlines() if 'rank=\"' in ln})
+assert 'ramba_serve_tenant_flushes_total' in body, body[:400]
+assert 'ramba_flush_e2e_seconds_bucket' in body, body[:400]
+print('TELEMETRY_LEG_SCRAPE rank=%d labels=%s port=%d' % (
+    rank, ','.join(labels), port))
 """
 
 
@@ -526,6 +581,119 @@ def run_serving_leg() -> int:
     return 0 if ok else 1
 
 
+def run_telemetry_leg() -> int:
+    """Two ranks share ONE trace_id across their serving sessions, serve
+    /metrics concurrently, and scrape themselves; rank labels must be
+    distinct and the shared trace must land in both ranks' traces."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    basetemp = tempfile.mkdtemp(prefix="ramba_2proc_telem_")
+    trace_base = os.path.join(basetemp, "trace.jsonl")
+    shared_trace = "feedfacefeedface"
+    budget = float(os.environ.get("RAMBA_TEST_PROCS_TIMEOUT", "600"))
+
+    procs, logs = [], []
+    for rank in range(2):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        for k in ("RAMBA_TEST_PROCS", "RAMBA_TEST_PROC_ID",
+                  "RAMBA_TEST_COORD", "RAMBA_TEST_SHARED_TMP",
+                  "RAMBA_PROFILE_DIR", "RAMBA_FAULTS", "RAMBA_HBM_BUDGET",
+                  "RAMBA_METRICS_PORT", "RAMBA_METRICS_FILE",
+                  "RAMBA_FLIGHT_DIR"):
+            env.pop(k, None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["RAMBA_TRACE"] = trace_base
+        log = open(os.path.join(basetemp, f"rank{rank}.log"), "w")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _TELEMETRY_WORKLOAD, str(rank),
+             f"localhost:{port}", shared_trace],
+            env=env, stdout=log, stderr=subprocess.STDOUT, cwd=REPO,
+        ))
+
+    deadline = time.time() + budget
+    rcs = [None, None]
+    try:
+        for i, p in enumerate(procs):
+            left = max(5.0, deadline - time.time())
+            try:
+                rcs[i] = p.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                rcs[i] = -9
+    finally:
+        for log in logs:
+            log.close()
+
+    ok = all(rc == 0 for rc in rcs)
+
+    # Each rank's scrape must be labeled with its OWN rank — concurrent
+    # exporters on one host stay distinguishable after aggregation.
+    labels = [None, None]
+    for rank in range(2):
+        path = os.path.join(basetemp, f"rank{rank}.log")
+        with open(path) as f:
+            tail = f.read().splitlines()
+        for line in tail:
+            if line.startswith(f"TELEMETRY_LEG_SCRAPE rank={rank} "):
+                labels[rank] = line.split("labels=")[1].split(" ")[0]
+        if labels[rank] is None:
+            ok = False
+        print(f"--- telemetry leg rank {rank} rc={rcs[rank]} ({path}) ---")
+        print("\n".join(tail[-(4 if ok else 40):]))
+    if ok:
+        if labels[0] == labels[1] or labels != [str(r) for r in range(2)]:
+            print(f"telemetry leg: FAIL (rank labels not distinct: "
+                  f"r0={labels[0]} r1={labels[1]})")
+            ok = False
+        else:
+            print(f"telemetry leg: scrapes labeled rank={labels[0]} / "
+                  f"rank={labels[1]}, distinct")
+
+    # One request, two ranks: the shared trace_id must appear in BOTH
+    # per-rank event files — what --trace needs to merge the story.
+    import json
+
+    for rank in range(2):
+        path = f"{trace_base}.rank{rank}"
+        try:
+            with open(path) as f:
+                evs = [json.loads(ln) for ln in f if ln.strip()]
+            traced = [e for e in evs if e.get("trace_id") == shared_trace
+                      or shared_trace in (e.get("trace_ids") or [])]
+            kinds = sorted({e.get("type", "?") for e in traced})
+            print(f"telemetry leg rank {rank}: {len(evs)} events, "
+                  f"{len(traced)} in trace {shared_trace} ({','.join(kinds)})")
+            if not traced:
+                print(f"telemetry leg rank {rank}: FAIL (shared trace "
+                      f"missing)")
+                ok = False
+        except (OSError, ValueError) as e:
+            print(f"telemetry leg rank {rank}: FAIL ({e})")
+            ok = False
+
+    # And the cross-rank causal chain must actually reconstruct.
+    if ok:
+        merged = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "trace_report.py"),
+             trace_base, "--trace", shared_trace],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        print(merged.stdout.strip())
+        if merged.returncode != 0 or "2 rank(s)" not in merged.stdout:
+            print(f"telemetry leg: FAIL (--trace rc={merged.returncode})")
+            print(merged.stderr.strip())
+            ok = False
+
+    print(f"two-process telemetry leg: {'OK' if ok else 'FAIL'}")
+    if ok:
+        shutil.rmtree(basetemp, ignore_errors=True)
+    return 0 if ok else 1
+
+
 def run_perf_leg() -> int:
     """Two ranks under RAMBA_PERF=1; both ledgers must report the same
     kernel fingerprint set, and the merged timeline must build."""
@@ -795,6 +963,8 @@ def main() -> int:
         return run_serving_leg()
     if "--elastic-leg" in sys.argv[1:]:
         return run_elastic_leg()
+    if "--telemetry-leg" in sys.argv[1:]:
+        return run_telemetry_leg()
     pytest_args = sys.argv[1:] or ["tests/"]
     with socket.socket() as s:
         s.bind(("localhost", 0))
