@@ -442,6 +442,66 @@ def _bench_observe(rt, platform):
     return out
 
 
+def _bench_autotune(rt, platform):
+    """Backend-autotune section (only when ``RAMBA_AUTOTUNE`` is armed):
+    drive the fused sin/cos chain until the ledger race latches, report
+    the race's measured overhead, then force each backend in turn on the
+    same chain for per-backend HBM throughput.  ``backend_selected_via``
+    flips to ``"autotune"`` when a decision was latched by measurement
+    rather than by device bring-up."""
+    from ramba_tpu.core import autotune as _autotune
+
+    out = {}
+    rep = _autotune.report()
+    if rep.get("mode") == "off" and not rep.get("decisions"):
+        return out
+
+    n = (1 << 24) if platform != "cpu" else (1 << 18)  # lane-aligned
+    base = rt.arange(n) / 1000.0
+    rt.sync()
+    itemsize = base.dtype.itemsize
+    gbytes = n * itemsize / 1e9
+
+    def chain():
+        t0 = time.perf_counter()
+        B = rt.sin(base)
+        C = rt.cos(base)
+        D = B * B + C * C
+        del B, C
+        float(rt.sum(D))
+        del D
+        return time.perf_counter() - t0
+
+    if _autotune.mode() == "race" and not _autotune.latched_via_autotune():
+        # ~2 compiles + 2K steady-state samples latch one fingerprint;
+        # the bound covers pipeline-deferred challenger compiles too.
+        for _ in range(4 * rep.get("k", 3) + 8):
+            chain()
+            if _autotune.latched_via_autotune():
+                break
+    rep = _autotune.report()
+    out["autotune_race_overhead_ms"] = round(
+        float(rep.get("race_overhead_s") or 0.0) * 1e3, 3)
+    if _autotune.latched_via_autotune():
+        out["backend_selected_via"] = "autotune"
+
+    prev = os.environ.get("RAMBA_AUTOTUNE")
+    try:
+        for backend in ("xla", "pallas"):
+            os.environ["RAMBA_AUTOTUNE"] = f"force:{backend}"
+            _autotune.reconfigure()
+            chain()  # compile
+            wall = min(chain() for _ in range(3))
+            out[f"hbm_gb_per_s_{backend}"] = round(gbytes / wall, 2)
+    finally:
+        if prev is None:
+            os.environ.pop("RAMBA_AUTOTUNE", None)
+        else:
+            os.environ["RAMBA_AUTOTUNE"] = prev
+        _autotune.reconfigure()
+    return out
+
+
 def _bench_dispatch_floor(rt):
     """Measured per-dispatch round-trip cost (flush + scalar fetch of a
     tiny computation): on a tunneled chip this floor dominates small
@@ -604,6 +664,11 @@ def main():
             out.update(_bench_observe(rt, platform))
         except Exception:  # noqa: BLE001
             out["observe_error"] = traceback.format_exc(limit=2)[-300:]
+
+        try:
+            out.update(_bench_autotune(rt, platform))
+        except Exception:  # noqa: BLE001
+            out["autotune_error"] = traceback.format_exc(limit=2)[-300:]
     except Exception:  # noqa: BLE001 - even import/backend failure emits JSON
         out["error"] = traceback.format_exc(limit=3)[-400:]
 

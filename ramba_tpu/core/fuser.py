@@ -619,27 +619,65 @@ def _cache_key(program: _Program, donate_key: tuple) -> tuple:
     return (program.key, donate_key, _semantic_fingerprint())
 
 
-def _get_compiled(program: _Program, donate_key: tuple):
+def _get_compiled(program: _Program, donate_key: tuple,
+                  leaf_vals=None, force_backend: Optional[str] = None):
     """Compile-cache lookup (mesh-epoch aware, true LRU).  Returns
-    ``(fn, is_new, fingerprint)`` where ``fingerprint`` is the stable
-    per-kernel key the cost ledger files this program under.  The whole
-    lookup runs under ``_cache_lock`` — jax.jit object creation is lazy
-    (the expensive XLA compile happens at first *call*, outside), so the
-    critical section stays short while concurrent streams can never
+    ``(fn, is_new, fingerprint, backend)`` where ``fingerprint`` is the
+    stable per-kernel key the cost ledger files this program under and
+    ``backend`` names the lowering that produced ``fn`` (``"xla"`` /
+    ``"pallas"``; None for the default XLA lowering when the autotuner is
+    not consulted).  The backend-selection seam: with ``RAMBA_AUTOTUNE``
+    armed and ``leaf_vals`` provided, ``core/autotune.py`` picks the
+    backend per fingerprint from the cost ledger; ``force_backend`` pins
+    it (races, prewarms, fallback retries).  XLA executables keep the
+    historical cache key so fingerprints stay stable across autotune
+    on/off; a Pallas executable lives under ``key + ("pallas",)`` — a
+    loser backend ages out through the same LRU as everything else.  The
+    whole lookup runs under ``_cache_lock`` — jax.jit object creation is
+    lazy (the expensive compile happens at first *call*, outside), so
+    the critical section stays short while concurrent streams can never
     corrupt the LRU order or double-count a miss."""
     global _cache_epoch
+    from ramba_tpu.core import autotune as _autotune
     with _cache_lock:
         if _cache_epoch != _mesh.mesh_epoch:
             _compile_cache.clear()
             _cache_epoch = _mesh.mesh_epoch
         key = _cache_key(program, donate_key)
         fp = _ledger.fingerprint(key)
-        fn = _compile_cache.pop(key, None)
+        if force_backend is not None:
+            backend = force_backend
+        elif leaf_vals is not None and _autotune.active():
+            backend, _via = _autotune.select(fp, program, leaf_vals)
+        else:
+            backend = None
+        cache_key = key if backend != "pallas" else key + ("pallas",)
+        fn = _compile_cache.pop(cache_key, None)
         if fn is not None:
-            _compile_cache[key] = fn  # re-insert: move to MRU position
+            _compile_cache[cache_key] = fn  # re-insert: move to MRU position
             _registry.inc("fuser.cache_hit")
             _ledger.record_cache(fp, "hit")
-            return fn, False, fp
+            return fn, False, fp, backend
+        build = None
+        if backend == "pallas":
+            from ramba_tpu.ops import pallas_backend as _pallas
+            try:
+                build = _pallas.lower_program(program, leaf_vals)
+            except Exception as e:
+                _autotune.note_failure(fp, "pallas", e)
+                build = None
+            if build is None:
+                # not lowerable (or lowering failed): degrade to the XLA
+                # backend, re-checking the cache under the XLA key
+                backend = "xla" if force_backend is None \
+                    or _autotune.active() else None
+                cache_key = key
+                fn = _compile_cache.pop(cache_key, None)
+                if fn is not None:
+                    _compile_cache[cache_key] = fn
+                    _registry.inc("fuser.cache_hit")
+                    _ledger.record_cache(fp, "hit")
+                    return fn, False, fp, backend
         if len(_compile_cache) >= _COMPILE_CACHE_MAX:
             old_key = next(iter(_compile_cache))  # LRU: least recently used
             _compile_cache.pop(old_key)
@@ -651,13 +689,15 @@ def _get_compiled(program: _Program, donate_key: tuple):
                 "capacity": _COMPILE_CACHE_MAX,
             })
         _faults.check("compile", instrs=len(program.instrs))
-        fn = jax.jit(_build_callable(program), donate_argnums=donate_key)
-        _compile_cache[key] = fn
+        fn = jax.jit(build if build is not None
+                     else _build_callable(program),
+                     donate_argnums=donate_key)
+        _compile_cache[cache_key] = fn
         with _stats_lock:
             stats["compiles"] += 1
         _registry.inc("fuser.cache_miss")
         _ledger.record_cache(fp, "miss")
-        return fn, True, fp
+        return fn, True, fp, backend
 
 
 def _last_use_map(program: _Program) -> dict:
@@ -785,7 +825,7 @@ def _run_segmented(program: _Program, leaf_vals: list, donate_idx: tuple,
                 continue  # caller-visible leaf not cleared for donation
             if _nbytes(vals[s]) >= DONATE_MIN_BYTES:
                 seg_donate.append(j)
-        fn, is_new, fp = _get_compiled(seg_prog, tuple(seg_donate))
+        fn, is_new, fp, _backend = _get_compiled(seg_prog, tuple(seg_donate))
         seg_vals = [vals[s] for s in in_slots]
         outs = _execute_compiled(fn, seg_prog, seg_vals, is_new, span=span,
                                  fp=fp, rung=rung, donated=len(seg_donate))
@@ -824,7 +864,8 @@ def _run_chunked(program: _Program, leaf_vals, donate_idx: tuple,
 
 def _execute_compiled(fn, program: _Program, leaf_vals, is_new: bool,
                       span: Optional[dict] = None, fp: Optional[str] = None,
-                      rung: str = "fused", donated: int = 0):
+                      rung: str = "fused", donated: int = 0,
+                      backend: Optional[str] = None):
     """Run one compiled program with the shared observability treatment:
     RAMBA_SHOW_CODE dump on first compile, profiler TraceAnnotation at
     RAMBA_TIMING>=2 or under RAMBA_PROFILE_DIR, first-call
@@ -838,7 +879,7 @@ def _execute_compiled(fn, program: _Program, leaf_vals, is_new: bool,
     if is_new and _ledger.cost_enabled() and fp is not None:
         # Before execution: donated input buffers are dead afterwards, and
         # AOT lowering wants live avals.
-        _ledger.capture_cost(fp, fn, leaf_vals)
+        _ledger.capture_cost(fp, fn, leaf_vals, backend=backend)
     if is_new and common.show_code:
         import sys
 
@@ -885,29 +926,101 @@ def _execute_compiled(fn, program: _Program, leaf_vals, is_new: bool,
             is_new, bytes_in=bytes_in,
             bytes_out=sum(_nbytes(o) for o in outs),
             donated=donated, sync_seconds=sync_dt,
-            tenant=current_tenant(),
+            tenant=current_tenant(), backend=backend,
         )
     if span is not None:
-        span["calls"].append({
+        call = {
             "label": _program_label(program),
             "cache": "miss" if is_new else "hit",
             "seconds": round(dt, 6),
-        })
+        }
+        if backend is not None:
+            call["backend"] = backend
+        span["calls"].append(call)
     return outs
 
 
 def _attempt_fused(program: _Program, leaf_vals, donate_key: tuple,
                    span: Optional[dict]):
     """Rung 0: the normal fused path (monolithic jit, or the standard
-    segmented executor above ``common.max_program_instrs``)."""
+    segmented executor above ``common.max_program_instrs``).  With
+    ``RAMBA_AUTOTUNE`` armed this is where the backend race plays out:
+    the autotuner may hand back the Pallas lowering, whose first
+    (compile-paying) call is deferred through the async compile pipeline
+    when one is live, and whose failures degrade to the XLA backend —
+    recorded on the ledger — before the resilience ladder is ever
+    involved."""
     if (
         common.max_program_instrs
         and len(program.instrs) > common.max_program_instrs
     ):
         return _run_segmented(program, leaf_vals, donate_key, span=span)
-    fn, is_new, fp = _get_compiled(program, donate_key)
+    fn, is_new, fp, backend = _get_compiled(program, donate_key,
+                                            leaf_vals=leaf_vals)
+    if backend == "pallas":
+        from ramba_tpu.core import autotune as _autotune
+
+        if is_new and _autotune.mode() == "race":
+            # Race compiles must not stall this flush (or, on the async
+            # path, other tenants' tickets): when a compile pipeline is
+            # live, warm the Pallas executable through it and serve this
+            # flush from the XLA backend meanwhile.  (force:<backend>
+            # deliberately compiles inline — the operator asked for that
+            # backend now, not eventually.)
+            pipe = None
+            try:
+                from ramba_tpu.serve import pipeline as _pipeline
+                pipe = _pipeline.current_pipeline()
+            except Exception:
+                pipe = None
+            # single-controller only: async warm completion would skew
+            # the per-rank race counts out of SPMD lockstep, and the
+            # latch agreement collective relies on that lockstep
+            if pipe is not None and hasattr(pipe, "submit_warm") \
+                    and jax.process_count() == 1:
+                _autotune.maybe_prewarm(fp, program, leaf_vals, donate_key)
+                fn, is_new, fp, backend = _get_compiled(
+                    program, donate_key, leaf_vals=leaf_vals,
+                    force_backend="xla")
+                return _execute_compiled(
+                    fn, program, leaf_vals, is_new, span=span, fp=fp,
+                    rung="fused", donated=len(donate_key), backend=backend)
+        try:
+            return _execute_compiled(
+                fn, program, leaf_vals, is_new, span=span, fp=fp,
+                rung="fused", donated=len(donate_key), backend=backend)
+        except _faults.InjectedFault:
+            # execute/oom fault sites belong to the resilience ladder,
+            # not to backend selection (the "pallas" fault site fires at
+            # lowering time, inside _get_compiled)
+            raise
+        except Exception as e:
+            # A Pallas kernel that traced fine can still fail at first
+            # call (Mosaic compile) or at dispatch.  Degrade to the XLA
+            # backend for this fingerprint — permanently — provided no
+            # leaf buffer was consumed by the failed attempt.
+            for v in leaf_vals:
+                is_deleted = getattr(v, "is_deleted", None)
+                if is_deleted is not None and is_deleted():
+                    raise
+            _autotune.note_failure(fp, "pallas", e)
+            with _cache_lock:
+                _compile_cache.pop(
+                    _cache_key(program, donate_key) + ("pallas",), None)
+            _events.emit({
+                "type": "degrade", "site": "backend", "action": "backend",
+                "from": "pallas", "to": "xla",
+                "error": f"{type(e).__name__}: {e}"[:300],
+            })
+            fn, is_new, fp, backend = _get_compiled(
+                program, donate_key, leaf_vals=leaf_vals,
+                force_backend="xla")
+            return _execute_compiled(
+                fn, program, leaf_vals, is_new, span=span, fp=fp,
+                rung="fused", donated=len(donate_key), backend=backend)
     return _execute_compiled(fn, program, leaf_vals, is_new, span=span,
-                             fp=fp, rung="fused", donated=len(donate_key))
+                             fp=fp, rung="fused", donated=len(donate_key),
+                             backend=backend)
 
 
 def _run_eager(program: _Program, leaf_vals, span: Optional[dict]):

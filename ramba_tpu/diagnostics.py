@@ -64,10 +64,21 @@ def perf_report() -> dict:
     per-degradation-rung execution counts, XLA cost_analysis flops and
     bytes-accessed when captured — plus per-program flush wall-time
     windows and the slow-flush sentinel tally.  This is the capture
-    format ``scripts/perf_diff.py`` compares."""
+    format ``scripts/perf_diff.py`` compares.  When the backend
+    autotuner is active (or has latched decisions), an ``autotune``
+    section reports its mode, decision table, and race overhead."""
     from ramba_tpu.observe import ledger as _ledger
 
-    return _ledger.snapshot()
+    snap = _ledger.snapshot()
+    try:
+        from ramba_tpu.core import autotune as _autotune
+
+        rep = _autotune.report()
+        if rep.get("mode") != "off" or rep.get("decisions"):
+            snap["autotune"] = rep
+    except Exception:
+        pass
+    return snap
 
 
 def serving_report() -> dict:

@@ -229,6 +229,38 @@ def fingerprint(cache_key) -> str:
 # ---------------------------------------------------------------------------
 
 
+class BackendStats:
+    """Per-lowering-backend cost slice of one kernel entry (the
+    autotuner's evidence: ``xla`` vs ``pallas`` execution percentiles,
+    compile cost, analytic flops/bytes, and fallback count)."""
+
+    __slots__ = ("exec", "compiles", "compile_s", "flops",
+                 "bytes_accessed", "fallbacks", "_cost_tried")
+
+    def __init__(self):
+        self.exec = _Rolling()
+        self.compiles = 0
+        self.compile_s = 0.0
+        self.flops: Optional[float] = None
+        self.bytes_accessed: Optional[float] = None
+        self.fallbacks = 0
+        self._cost_tried = False
+
+    def summary(self) -> dict:
+        out = {
+            "compiles": self.compiles,
+            "compile_s": round(self.compile_s, 6),
+            "exec": self.exec.summary(),
+        }
+        if self.flops is not None:
+            out["flops"] = self.flops
+        if self.bytes_accessed is not None:
+            out["bytes_accessed"] = self.bytes_accessed
+        if self.fallbacks:
+            out["fallbacks"] = self.fallbacks
+        return out
+
+
 class KernelEntry:
     """All accumulated cost knowledge about one compiled kernel."""
 
@@ -236,7 +268,7 @@ class KernelEntry:
         "label", "instrs", "donated", "compiles", "compile_s",
         "exec", "sync", "bytes_in", "bytes_out",
         "hits", "misses", "evicts", "rungs", "tenants",
-        "flops", "bytes_accessed", "_cost_tried",
+        "flops", "bytes_accessed", "_cost_tried", "backends",
     )
 
     def __init__(self, label: str = "?", instrs: int = 0, donated: int = 0):
@@ -259,6 +291,16 @@ class KernelEntry:
         self.flops: Optional[float] = None
         self.bytes_accessed: Optional[float] = None
         self._cost_tried = False
+        # backend name ("xla"/"pallas") -> BackendStats; empty until a
+        # dispatch carries an explicit backend label, so pre-autotune
+        # summaries are byte-identical to the historical shape
+        self.backends: dict = {}
+
+    def backend(self, name: str) -> BackendStats:
+        b = self.backends.get(name)
+        if b is None:
+            b = self.backends[name] = BackendStats()
+        return b
 
     def summary(self) -> dict:
         out = {
@@ -282,6 +324,9 @@ class KernelEntry:
             out["flops"] = self.flops
         if self.bytes_accessed is not None:
             out["bytes_accessed"] = self.bytes_accessed
+        if self.backends:
+            out["backends"] = {name: b.summary()
+                               for name, b in self.backends.items()}
         return out
 
 
@@ -320,14 +365,18 @@ def record_execute(fp: str, label: str, instrs: int, rung: str,
                    bytes_in: int = 0, bytes_out: int = 0,
                    donated: int = 0,
                    sync_seconds: Optional[float] = None,
-                   tenant: Optional[str] = None) -> None:
+                   tenant: Optional[str] = None,
+                   backend: Optional[str] = None) -> None:
     """One execution of a compiled (or interpreted) kernel.
 
     First calls (``is_new``) pay jit trace + lower + XLA compile and are
     accounted as compile wall time, NOT as execution samples — mixing
     them in would poison the steady-state percentiles the sentinel and
     perf_diff compare against.  ``tenant`` (a serving session's identity)
-    accumulates a per-tenant execution count on the entry."""
+    accumulates a per-tenant execution count on the entry.  ``backend``
+    (a lowering backend name, ``xla``/``pallas``) additionally records
+    the sample in that backend's slice — the per-fingerprint evidence
+    ``core/autotune.py`` races on."""
     with _lock:
         e = _entry(fp, label, instrs, donated)
         e.instrs = instrs or e.instrs
@@ -346,21 +395,75 @@ def record_execute(fp: str, label: str, instrs: int, rung: str,
                 if e.sync is None:
                     e.sync = _Rolling()
                 e.sync.add(sync_seconds)
+        if backend is not None:
+            b = e.backend(backend)
+            if is_new:
+                b.compiles += 1
+                b.compile_s += seconds
+            else:
+                b.exec.add(seconds)
 
 
-def capture_cost(fp: str, fn, leaf_vals) -> None:
+def record_backend_fallback(fp: str, backend: str, err: str,
+                            label: Optional[str] = None) -> None:
+    """One failed attempt to run ``backend`` for this kernel (e.g. a
+    Pallas Mosaic compile error): counted on the backend slice, mirrored
+    on the observability stream so post-mortems see the degradation."""
+    with _lock:
+        e = _entry(fp, label)
+        e.backend(backend).fallbacks += 1
+    _registry.inc("autotune.backend_fallback")
+    _events.emit({
+        "type": "backend_fallback",
+        "fingerprint": fp,
+        "backend": backend,
+        "error": str(err)[:200],
+    })
+
+
+def backend_stats(fp: str) -> dict:
+    """Autotuner read API: backend name -> (exec samples, exec p50,
+    total exec seconds, compile seconds, fallbacks) for one kernel.
+    Returns {} for unknown fingerprints."""
+    with _lock:
+        e = _kernels.get(fp)
+        if e is None:
+            return {}
+        out = {}
+        for name, b in e.backends.items():
+            out[name] = {
+                "count": b.exec.count,
+                "p50_s": b.exec.quantile(0.50),
+                "total_s": b.exec.total,
+                "compile_s": b.compile_s,
+                "fallbacks": b.fallbacks,
+            }
+        return out
+
+
+def capture_cost(fp: str, fn, leaf_vals,
+                 backend: Optional[str] = None) -> None:
     """Attach XLA AOT ``cost_analysis()`` flops / bytes-accessed to the
     kernel entry, once, when ``RAMBA_PERF`` is on.  The AOT
     lower+compile is a second compilation of the same program — strictly
     opt-in and once per kernel; any failure (backend without
-    cost_analysis, extended dtypes) just leaves the fields absent."""
+    cost_analysis, extended dtypes) just leaves the fields absent.
+    With ``backend`` the capture lands on that backend's slice (once per
+    backend), on top of the entry-level once-only capture."""
     if not cost_enabled():
         return
     with _lock:
         e = _entry(fp)
-        if e._cost_tried:
-            return
-        e._cost_tried = True
+        b = e.backend(backend) if backend is not None else None
+        if b is not None:
+            if b._cost_tried and e._cost_tried:
+                return
+            b._cost_tried = True
+            e._cost_tried = True
+        else:
+            if e._cost_tried:
+                return
+            e._cost_tried = True
     try:
         compiled = fn.lower(*leaf_vals).compile()
         ca = compiled.cost_analysis()
@@ -369,11 +472,18 @@ def capture_cost(fp: str, fn, leaf_vals) -> None:
         if not ca:
             return
         flops = ca.get("flops")
-        if flops is not None:
-            e.flops = float(flops)
         ba = ca.get("bytes accessed")
-        if ba is not None:
-            e.bytes_accessed = float(ba)
+        with _lock:
+            if flops is not None:
+                if e.flops is None:
+                    e.flops = float(flops)
+                if b is not None:
+                    b.flops = float(flops)
+            if ba is not None:
+                if e.bytes_accessed is None:
+                    e.bytes_accessed = float(ba)
+                if b is not None:
+                    b.bytes_accessed = float(ba)
     except Exception:
         pass
 

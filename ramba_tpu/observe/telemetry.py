@@ -308,6 +308,21 @@ def _ledger_series(fams: _Families) -> None:
                  cache.get("hits", 0), lab)
         fams.add("ramba_kernel_cache_misses_total", "counter",
                  cache.get("misses", 0), lab)
+        for backend, b in e.get("backends", {}).items():
+            blab = {**lab, "backend": backend}
+            bex = b.get("exec", {})
+            fams.add("ramba_kernel_backend_exec_total", "counter",
+                     bex.get("count", 0), blab)
+            fams.add("ramba_kernel_backend_exec_seconds_total", "counter",
+                     bex.get("total_s", 0) or 0, blab)
+            p50 = bex.get("p50_s")
+            if p50 is not None:
+                fams.add("ramba_kernel_backend_exec_p50_seconds", "gauge",
+                         p50, blab)
+            fams.add("ramba_kernel_backend_compile_seconds_total", "counter",
+                     b.get("compile_s", 0), blab)
+            fams.add("ramba_kernel_backend_fallbacks_total", "counter",
+                     b.get("fallbacks", 0), blab)
 
 
 def _memory_series(fams: _Families) -> None:
@@ -349,6 +364,26 @@ def _slo_series(fams: _Families) -> None:
         fams.add("ramba_slo_breached", "gauge", 1, {"tenant": t})
 
 
+def _autotune_series(fams: _Families) -> None:
+    from ramba_tpu.core import autotune as _autotune
+
+    rep = _autotune.report()
+    if rep.get("mode") == "off" and not rep.get("decisions"):
+        return  # feature unused: keep the exposition quiet
+    fams.add("ramba_autotune_decisions", "gauge",
+             len(rep.get("decisions", {})))
+    fams.add("ramba_autotune_races_latched_total", "counter",
+             rep.get("races_latched", 0))
+    fams.add("ramba_autotune_race_overhead_seconds_total", "counter",
+             rep.get("race_overhead_s", 0.0))
+    per_backend: dict = {}
+    for d in rep.get("decisions", {}).values():
+        per_backend[d.get("backend")] = per_backend.get(d.get("backend"), 0) + 1
+    for backend, n in sorted(per_backend.items()):
+        fams.add("ramba_autotune_backend_decisions", "gauge", n,
+                 {"backend": backend})
+
+
 def _elastic_series(fams: _Families) -> None:
     from ramba_tpu.resilience import elastic as _elastic
 
@@ -379,6 +414,10 @@ def render() -> str:
     except Exception:
         pass  # governor not imported/available: skip its families
     _slo_series(fams)
+    try:
+        _autotune_series(fams)
+    except Exception:
+        pass  # autotuner not imported/available: skip its families
     try:
         _elastic_series(fams)
     except Exception:

@@ -93,6 +93,45 @@ class FlushTicket:
         return self.result
 
 
+class _WarmWork:
+    """Minimal work stub for warm tasks: no program, no fingerprint (a
+    None fingerprint also tells the fairness queue not to coalesce past
+    it), no SLO clock."""
+
+    __slots__ = ("fingerprint", "enqueued_at", "span")
+
+    def __init__(self):
+        self.fingerprint = None
+        self.enqueued_at = None
+        self.span: dict = {}
+
+
+class _WarmStream:
+    """Stream stub so ``_finish`` bookkeeping works on warm tickets."""
+
+    __slots__ = ("inflight", "tenant", "name")
+
+    def __init__(self, label: str):
+        self.inflight: list = []
+        self.tenant = "_autotune"
+        self.name = label
+
+
+class WarmTicket(FlushTicket):
+    """A background thunk riding the dispatch queue — used by the backend
+    autotuner to pay challenger (Pallas) compiles off the serving hot
+    path.  Fairness still applies: warm tasks queue under their own
+    tenant, so they take round-robin turns instead of starving real
+    flushes."""
+
+    __slots__ = ("thunk", "label")
+
+    def __init__(self, thunk, label: str):
+        super().__init__(_WarmStream(label), _WarmWork())
+        self.thunk = thunk
+        self.label = label
+
+
 class CompilePipeline:
     """The background dispatch worker + its fairness queue."""
 
@@ -173,6 +212,19 @@ class CompilePipeline:
         self._ensure_worker()
         return ticket
 
+    def submit_warm(self, thunk, label: str = "warm") -> WarmTicket:
+        """Enqueue a background thunk (e.g. an autotune challenger
+        compile) on the dispatch worker.  The thunk runs under the
+        ``_autotune`` tenant — round-robin fairness keeps it from
+        starving real flushes — and never coalesces (its fingerprint is
+        None).  Errors are captured on the ticket, not raised: a failed
+        warm-up must not take down the worker."""
+        ticket = WarmTicket(thunk, label)
+        _registry.inc("serve.warm_enqueued")
+        self.queue.push(ticket.stream.tenant, ticket)
+        self._ensure_worker()
+        return ticket
+
     # -- dispatch ----------------------------------------------------------
 
     def _finish(self, ticket: FlushTicket, result=None, error=None) -> None:
@@ -214,6 +266,16 @@ class CompilePipeline:
                 ev["trace_ids"] = trace_ids
             _events.emit(ev)
         for ticket in group:
+            if isinstance(ticket, WarmTicket):
+                # Warm tasks carry a bare thunk, not prepared flush work.
+                try:
+                    ticket.thunk()
+                except BaseException as e:  # noqa: BLE001 — captured, not fatal
+                    _registry.inc("serve.warm_failed")
+                    self._finish(ticket, error=e)
+                else:
+                    self._finish(ticket, result=[])
+                continue
             ticket.coalesced = n
             work = ticket.work
             work.span["async"] = True

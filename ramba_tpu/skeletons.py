@@ -858,11 +858,12 @@ def _eval_stencil(static, *arrs):
                     f"shifted-slice path: {type(e).__name__}: {e}"
                 )
     if len(arrs[0].shape) == 2:
-        from ramba_tpu.ops import stencil_pallas
+        from ramba_tpu.ops import pallas_backend
 
-        if stencil_pallas.available(arrs):
+        fam = pallas_backend.family("stencil")
+        if fam is not None and fam.available(arrs):
             try:
-                return stencil_pallas.run(func, lo, hi, slots, arrs, taps)
+                return fam.run(func, lo, hi, slots, arrs, taps)
             except Exception as e:  # fall back to the XLA path, but say so
                 if not _pallas_fallback_warned:
                     _pallas_fallback_warned = True

@@ -43,6 +43,9 @@ _METRIC_DIRECTION = {
     "bcast_gelems_per_s": "higher",
     "hbm_gb_per_s": "higher",
     "hbm_gb_per_s_net": "higher",
+    "hbm_gb_per_s_xla": "higher",       # per-backend (autotune forced runs)
+    "hbm_gb_per_s_pallas": "higher",
+    "autotune_race_overhead_ms": "lower",
     "matmul_tflops": "higher",
     "serving_flushes_per_s": "higher",
     "serving_p95_flush_ms": "lower",

@@ -22,3 +22,5 @@ echo "=== leg 8: elastic lifecycle (2-rank checkpoint, 1-rank resume) ==="
 python scripts/two_process_suite.py --elastic-leg
 echo "=== leg 9: live telemetry (2-rank exporters, shared cross-rank trace) ==="
 python scripts/two_process_suite.py --telemetry-leg
+echo "=== leg 10: backend autotune race (2-rank, same backend latched per fingerprint) ==="
+python scripts/two_process_suite.py --autotune-leg

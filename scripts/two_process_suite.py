@@ -182,6 +182,54 @@ print('PERF_LEG_KEYS rank=%d %s' % (rank, ','.join(keys)))
 """
 
 
+# SPMD workload for the autotune leg: each rank forms the process group
+# and drives the same fused chain under RAMBA_AUTOTUNE=race until the
+# backend race latches (or the iteration budget runs out), then prints
+# its decision table.  Selection is ledger-count-driven, and counts
+# advance in lockstep under SPMD, so both ranks must latch the SAME
+# backend per fingerprint — the runner compares the tables.
+# argv: <rank> <coordinator>.
+_AUTOTUNE_WORKLOAD = """
+import os
+import sys
+rank, coord = int(sys.argv[1]), sys.argv[2]
+from ramba_tpu.parallel import distributed
+distributed.initialize(coordinator_address=coord, num_processes=2,
+                       process_id=rank)
+import jax
+assert jax.process_count() == 2, jax.process_count()
+import ramba_tpu as rt
+from ramba_tpu.core import autotune
+assert autotune.mode() == 'race', autotune.mode()
+n = 128 * 256
+base = rt.arange(n) / 1000.0
+rt.sync()
+vals = []
+for _ in range(20):
+    B = rt.sin(base)
+    C = rt.cos(base)
+    D = B * B + C * C
+    del B, C
+    vals.append(float(rt.sum(D)))
+    del D
+    if autotune.latched_via_autotune():
+        break
+assert max(vals) == min(vals), vals
+rep = autotune.report()
+dec = {fp: d['backend'] for fp, d in rep['decisions'].items()}
+assert dec, rep
+cache = os.environ.get('RAMBA_AUTOTUNE_CACHE')
+if cache:
+    import json
+    with open(cache) as f:
+        table = json.load(f)
+    for fp, b in dec.items():
+        assert table['decisions'][fp]['backend'] == b, (fp, table)
+print('AUTOTUNE_LEG_DECISIONS rank=%d %s'
+      % (rank, ','.join('%s=%s' % kv for kv in sorted(dec.items()))))
+"""
+
+
 # SPMD workload for the serving leg: each rank opens one serving session
 # and pushes four structurally-identical flushes plus one distinct one
 # through the async pipeline's enqueue/dispatch seam, driving dispatch
@@ -784,6 +832,81 @@ def run_perf_leg() -> int:
     return 0 if ok else 1
 
 
+def run_autotune_leg() -> int:
+    """Two ranks under RAMBA_AUTOTUNE=race; both must latch the SAME
+    backend per kernel fingerprint (selection is ledger-count-driven and
+    counts advance in SPMD lockstep), and each rank's persisted decision
+    table must agree with its in-memory decisions."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    basetemp = tempfile.mkdtemp(prefix="ramba_2proc_autotune_")
+    budget = float(os.environ.get("RAMBA_TEST_PROCS_TIMEOUT", "600"))
+
+    procs, logs = [], []
+    for rank in range(2):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        for k in ("RAMBA_TEST_PROCS", "RAMBA_TEST_PROC_ID",
+                  "RAMBA_TEST_COORD", "RAMBA_TEST_SHARED_TMP",
+                  "RAMBA_PROFILE_DIR", "RAMBA_FAULTS", "RAMBA_HBM_BUDGET"):
+            env.pop(k, None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["RAMBA_AUTOTUNE"] = "race"
+        env["RAMBA_AUTOTUNE_K"] = "2"
+        env["RAMBA_AUTOTUNE_CACHE"] = os.path.join(
+            basetemp, f"autotune.rank{rank}.json")
+        log = open(os.path.join(basetemp, f"rank{rank}.log"), "w")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _AUTOTUNE_WORKLOAD, str(rank),
+             f"localhost:{port}"],
+            env=env, stdout=log, stderr=subprocess.STDOUT, cwd=REPO,
+        ))
+
+    deadline = time.time() + budget
+    rcs = [None, None]
+    try:
+        for i, p in enumerate(procs):
+            left = max(5.0, deadline - time.time())
+            try:
+                rcs[i] = p.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                rcs[i] = -9
+    finally:
+        for log in logs:
+            log.close()
+
+    ok = all(rc == 0 for rc in rcs)
+
+    decisions = [None, None]
+    for rank in range(2):
+        path = os.path.join(basetemp, f"rank{rank}.log")
+        with open(path) as f:
+            tail = f.read().splitlines()
+        for line in tail:
+            if line.startswith(f"AUTOTUNE_LEG_DECISIONS rank={rank} "):
+                decisions[rank] = line.split(" ", 2)[2]
+        if decisions[rank] is None:
+            ok = False
+        print(f"--- autotune leg rank {rank} rc={rcs[rank]} ({path}) ---")
+        print("\n".join(tail[-(4 if ok else 40):]))
+    if ok and decisions[0] != decisions[1]:
+        print(f"autotune leg: FAIL (backend decisions diverge: "
+              f"r0={decisions[0]} r1={decisions[1]})")
+        ok = False
+    elif ok:
+        print(f"autotune leg: decisions identical on both ranks "
+              f"({decisions[0]})")
+
+    print(f"two-process autotune leg: {'OK' if ok else 'FAIL'}")
+    if ok:
+        shutil.rmtree(basetemp, ignore_errors=True)
+    return 0 if ok else 1
+
+
 def run_memory_leg() -> int:
     """Two ranks under a tiny HBM budget; admission control must route
     both to the chunked rung, in lockstep, with the correct result."""
@@ -965,6 +1088,8 @@ def main() -> int:
         return run_elastic_leg()
     if "--telemetry-leg" in sys.argv[1:]:
         return run_telemetry_leg()
+    if "--autotune-leg" in sys.argv[1:]:
+        return run_autotune_leg()
     pytest_args = sys.argv[1:] or ["tests/"]
     with socket.socket() as s:
         s.bind(("localhost", 0))

@@ -1,0 +1,520 @@
+"""Pallas kernel backend + ledger-driven autotuner (ISSUE 9).
+
+Covers ``ramba_tpu.core.autotune`` + ``ramba_tpu.ops.pallas_backend`` +
+the fuser backend seam:
+
+* mode parsing (``off`` / ``race`` / ``force:<backend>``) and the
+  selection state machine on a deterministic fake ledger — alternation
+  order, latch-on-K-samples, lower-p50 wins,
+* persisted decision table: atomic write, reload across a simulated
+  restart (``via: persisted``), and the second process skipping the race
+  (``autotune.race_started`` does not advance),
+* Pallas interpret-mode parity, byte-identical vs the XLA lowering for
+  every registered kernel family: fused elementwise chains (map/cast +
+  vector outputs), reductions on exact data (int sum) and on
+  order-independent kinds (float min/max), and masked segment reductions
+  (groupby sum/prod/min/max),
+* seeded ``RAMBA_FAULTS=pallas:once`` leg: Pallas lowering failure
+  degrades to XLA, latches ``via: fallback``, and records the fallback
+  on the kernel ledger + event stream,
+* the loser's compiled executable staying evictable through the
+  existing true-LRU compile cache,
+* race compiles offloaded through ``CompilePipeline.submit_warm`` (the
+  flush that triggers a fresh Pallas compile is served from XLA while
+  the challenger warms in the background),
+* observability: ``diagnostics.perf_report()["autotune"]`` and the
+  per-backend ledger columns in the telemetry exposition.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax as _jax
+import ramba_tpu as rt
+from ramba_tpu import diagnostics
+from ramba_tpu.core import autotune, fuser
+from ramba_tpu.observe import events, ledger
+from ramba_tpu.ops import pallas_backend
+from ramba_tpu.resilience import faults
+
+_MULTIPROC = _jax.process_count() > 1
+
+
+def _counter(name):
+    return diagnostics.counters().get(name, 0)
+
+
+@pytest.fixture
+def clean_autotune():
+    """Autotune disarmed + pristine state, whatever the ambient env says;
+    restores the env-driven configuration afterwards."""
+    saved = {
+        k: os.environ.pop(k, None)
+        for k in ("RAMBA_AUTOTUNE", "RAMBA_AUTOTUNE_K", "RAMBA_AUTOTUNE_CACHE")
+    }
+    autotune.reconfigure()
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    autotune.reconfigure()
+
+
+def _seed_exec(fp, backend, seconds, n=1):
+    for _ in range(n):
+        ledger.record_execute(fp, "fake", 1, "fused", seconds,
+                              is_new=False, backend=backend)
+
+
+# ---------------------------------------------------------------------------
+# mode parsing + selection state machine (deterministic fake ledger)
+# ---------------------------------------------------------------------------
+
+
+class TestModes:
+    def test_mode_parsing(self, clean_autotune):
+        for raw, want in (("", "off"), ("0", "off"), ("off", "off"),
+                          ("race", "race"), ("1", "race"), ("on", "race"),
+                          ("force:pallas", "force"), ("force:xla", "force"),
+                          ("garbage", "off")):
+            autotune.reconfigure(mode=raw)
+            assert autotune.mode() == want, raw
+        autotune.reconfigure(mode="force:pallas")
+        assert autotune.active()
+        autotune.reconfigure(mode="off")
+        assert not autotune.active()
+
+    def test_env_driven_reconfigure(self, clean_autotune):
+        os.environ["RAMBA_AUTOTUNE"] = "race"
+        os.environ["RAMBA_AUTOTUNE_K"] = "7"
+        autotune.reconfigure()
+        assert autotune.mode() == "race"
+        assert autotune.report()["k"] == 7
+
+    def test_off_mode_is_default_xla(self, clean_autotune):
+        assert autotune.select("fp-off", None, []) == ("xla", "default")
+
+    def test_force_modes(self, clean_autotune, monkeypatch):
+        monkeypatch.setattr(pallas_backend, "supports", lambda *a: True)
+        autotune.reconfigure(mode="force:pallas")
+        assert autotune.select("fp-f1", None, []) == ("pallas", "forced")
+        autotune.reconfigure(mode="force:xla")
+        assert autotune.select("fp-f1", None, []) == ("xla", "forced")
+        # a program the Pallas backend can't lower is never forced onto it
+        monkeypatch.setattr(pallas_backend, "supports", lambda *a: False)
+        autotune.reconfigure(mode="force:pallas")
+        assert autotune.select("fp-f2", None, []) == ("xla", "default")
+
+
+class TestRace:
+    def test_fake_ledger_race_latches_faster_backend(self, clean_autotune,
+                                                     monkeypatch):
+        autotune.reconfigure(mode="race", k=2)
+        monkeypatch.setattr(pallas_backend, "supports", lambda *a: True)
+        fp = "fp-race-pallas-wins"
+        before = _counter("autotune.race_started")
+        # empty ledger: the challenger races first (pays compile early)
+        assert autotune.select(fp, None, []) == ("pallas", "racing")
+        assert _counter("autotune.race_started") == before + 1
+        _seed_exec(fp, "pallas", 0.001, n=2)
+        # alternation steers toward the backend with fewer samples
+        assert autotune.select(fp, None, []) == ("xla", "racing")
+        _seed_exec(fp, "xla", 0.005, n=2)
+        # both hold K steady-state samples: lower p50 latches
+        assert autotune.select(fp, None, []) == ("pallas", "autotune")
+        assert autotune.decision(fp) == {"backend": "pallas",
+                                         "via": "autotune"}
+        assert autotune.latched_via_autotune()
+        # latched decisions are sticky — no more ledger consultation
+        _seed_exec(fp, "xla", 0.0001, n=10)
+        assert autotune.select(fp, None, []) == ("pallas", "autotune")
+
+    def test_fake_ledger_race_xla_wins(self, clean_autotune, monkeypatch):
+        autotune.reconfigure(mode="race", k=1)
+        monkeypatch.setattr(pallas_backend, "supports", lambda *a: True)
+        fp = "fp-race-xla-wins"
+        _seed_exec(fp, "pallas", 0.004)
+        _seed_exec(fp, "xla", 0.002)
+        assert autotune.select(fp, None, []) == ("xla", "autotune")
+        rep = autotune.report()
+        assert rep["races_latched"] >= 1
+        # the loser's measured time is the race overhead
+        assert rep["race_overhead_s"] >= 0.004
+
+    def test_unsupported_program_never_races(self, clean_autotune,
+                                             monkeypatch):
+        autotune.reconfigure(mode="race", k=1)
+        monkeypatch.setattr(pallas_backend, "supports", lambda *a: False)
+        before = _counter("autotune.race_started")
+        assert autotune.select("fp-unsup", None, []) == ("xla", "default")
+        assert _counter("autotune.race_started") == before
+
+
+class TestPersistence:
+    def test_decision_table_roundtrip_skips_race(self, clean_autotune,
+                                                 tmp_path, monkeypatch):
+        cache = str(tmp_path / "autotune.json")
+        monkeypatch.setattr(pallas_backend, "supports", lambda *a: True)
+        autotune.reconfigure(mode="race", k=1, cache_path=cache)
+        fp = "fp-persist"
+        _seed_exec(fp, "pallas", 0.001)
+        _seed_exec(fp, "xla", 0.005)
+        assert autotune.select(fp, None, []) == ("pallas", "autotune")
+        with open(cache) as f:
+            table = json.load(f)
+        assert table["decisions"][fp]["backend"] == "pallas"
+
+        # simulated restart: fresh in-memory state, same cache path
+        races_before = _counter("autotune.race_started")
+        loaded_before = _counter("autotune.table_loaded_decisions")
+        autotune.reconfigure(mode="race", k=1, cache_path=cache)
+        assert autotune.decision(fp) is None  # cleared — reload is lazy
+        assert autotune.select(fp, None, []) == ("pallas", "persisted")
+        assert autotune.latched_via_autotune()
+        # the second process never started a race for this fingerprint
+        assert _counter("autotune.race_started") == races_before
+        assert _counter("autotune.table_loaded_decisions") \
+            == loaded_before + 1
+
+    def test_missing_table_is_not_an_error(self, clean_autotune, tmp_path,
+                                           monkeypatch):
+        monkeypatch.setattr(pallas_backend, "supports", lambda *a: True)
+        autotune.reconfigure(mode="race", k=1,
+                             cache_path=str(tmp_path / "nope.json"))
+        assert autotune.select("fp-nocache", None, []) == ("pallas", "racing")
+
+    def test_fallback_persisted(self, clean_autotune, tmp_path):
+        cache = str(tmp_path / "autotune.json")
+        autotune.reconfigure(mode="race", k=1, cache_path=cache)
+        autotune.note_failure("fp-fb", "pallas", RuntimeError("mosaic"))
+        with open(cache) as f:
+            table = json.load(f)
+        assert table["decisions"]["fp-fb"] == {"backend": "xla",
+                                               "via": "fallback"}
+
+
+class TestFallback:
+    def test_note_failure_latches_xla(self, clean_autotune):
+        autotune.reconfigure(mode="race", k=1)
+        fp = "fp-fail"
+        before = _counter("autotune.backend_fallback")
+        autotune.note_failure(fp, "pallas", RuntimeError("boom"))
+        assert autotune.select(fp, None, []) == ("xla", "fallback")
+        assert _counter("autotune.backend_fallback") == before + 1
+        stats = ledger.backend_stats(fp)
+        assert stats["pallas"]["fallbacks"] == 1
+        evs = events.last(5, type="backend_fallback")
+        assert evs and evs[-1]["fingerprint"] == fp
+        assert not autotune.latched_via_autotune()
+
+
+# ---------------------------------------------------------------------------
+# Pallas interpret-mode parity: byte-identical vs the XLA lowering
+# ---------------------------------------------------------------------------
+
+
+def _forced(backend):
+    autotune.reconfigure(mode=f"force:{backend}")
+
+
+def _pallas_exec_count():
+    # compiles + steady-state samples: a single forced run is is_new and
+    # lands in the compile column, which still proves Pallas executed
+    total = 0
+    for e in ledger.snapshot().get("kernels", {}).values():
+        b = e.get("backends", {}).get("pallas")
+        if b:
+            total += b.get("exec", {}).get("count", 0) + b.get("compiles", 0)
+    return total
+
+
+@pytest.mark.skipif(_MULTIPROC, reason="forced-backend parity is a "
+                    "single-controller concern; the SPMD leg is "
+                    "two_process_suite --autotune-leg")
+class TestPallasParity:
+    N = 128 * 16  # lane-aligned 1-D length
+
+    def _both(self, build):
+        """Run ``build()`` under each forced backend; assert the Pallas
+        leg actually executed a Pallas kernel (no silent degrade)."""
+        _forced("xla")
+        ref = build()
+        before = _pallas_exec_count()
+        _forced("pallas")
+        got = build()
+        assert _pallas_exec_count() > before, \
+            "pallas backend did not execute (classifier rejected program?)"
+        return ref, got
+
+    def test_elemwise_chain_bytes_identical(self, clean_autotune):
+        base = rt.arange(self.N) / 7.0
+        rt.sync()
+
+        def build():
+            B = rt.sin(base)
+            C = rt.cos(base)
+            D = B * B + C * C
+            del B, C
+            s = float(rt.sum(D))
+            out = np.asarray(D)
+            del D
+            return out, s
+
+        (dx, sx), (dp, sp) = self._both(build)
+        assert dx.dtype == dp.dtype
+        assert np.array_equal(dx, dp)
+        # sin^2 + cos^2 sums exactly: every element is 1.0
+        assert sx == sp
+
+    def test_int_chain_and_sum_exact(self, clean_autotune):
+        base = rt.arange(self.N)
+        rt.sync()
+
+        def build():
+            return int(rt.sum(base * 3 + 1))
+
+        vx, vp = self._both(build)
+        assert vx == vp
+
+    def test_float_min_max_order_independent(self, clean_autotune):
+        base = rt.sin(rt.arange(self.N) / 3.0)
+        rt.sync()
+
+        def build():
+            D = base * 2.0
+            return float(rt.min(D)), float(rt.max(D))
+
+        (lo_x, hi_x), (lo_p, hi_p) = self._both(build)
+        assert lo_x == lo_p and hi_x == hi_p
+
+    def test_scalar_operand_promotion_matches(self, clean_autotune):
+        # python-scalar operands exercise the weak-type promotion plan
+        base = rt.arange(self.N) / 11.0
+        rt.sync()
+
+        def build():
+            D = rt.maximum(base, 0.25) * 2 + 1
+            out = np.asarray(D)
+            del D
+            return out
+
+        dx, dp = self._both(build)
+        assert dx.dtype == dp.dtype and np.array_equal(dx, dp)
+
+    def test_segment_reduce_parity(self, clean_autotune):
+        data = rt.arange(self.N) % 97
+        labels = np.arange(self.N) % 8
+        rt.sync()
+
+        def build():
+            out = {}
+            for kind in ("sum", "prod", "min", "max"):
+                g = data.groupby(0, labels, num_groups=8)
+                out[kind] = np.asarray(getattr(g, kind)())
+            return out
+
+        ref, got = self._both(build)
+        for kind in ref:
+            assert ref[kind].dtype == got[kind].dtype, kind
+            assert np.array_equal(ref[kind], got[kind]), kind
+
+    def test_stencil_family_registered_with_interpret_fallback(
+            self, clean_autotune, monkeypatch):
+        from ramba_tpu.ops import stencil_pallas
+
+        pallas_backend._ensure_builtins()
+        fam = pallas_backend.family("stencil")
+        assert fam is not None
+        assert "stencil" in pallas_backend.family_names()
+        # no TPU present: run() falls back to interpret=True instead of
+        # raising (the availability gate still keeps it off by default)
+        monkeypatch.setattr(stencil_pallas, "_INTERPRET", True)
+        monkeypatch.setattr(stencil_pallas, "_ENABLED", True)
+        from ramba_tpu.ops import stencil_sharded
+        monkeypatch.setattr(stencil_sharded, "eligible", lambda *a, **k: False)
+
+        @rt.stencil
+        def shifted(a):
+            return a[-1, 0] + a[0, 1]
+
+        x = np.arange(64, dtype=np.float32).reshape(8, 8)
+        out = rt.sstencil(shifted, rt.fromarray(x)).asarray()
+        e = np.zeros_like(x)
+        e[1:, :-1] = x[:-1, :-1] + x[1:, 1:]
+        np.testing.assert_allclose(out, e)
+
+
+# ---------------------------------------------------------------------------
+# fault injection: Pallas lowering failure degrades to XLA, on the record
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(_MULTIPROC, reason="single-controller fault leg")
+class TestFaultInjection:
+    def test_pallas_fault_degrades_to_xla_and_records(self, clean_autotune):
+        autotune.reconfigure(mode="race", k=2)
+        faults.configure("pallas:once")
+        try:
+            base = rt.arange(128 * 16) / 3.0
+            rt.sync()
+
+            def chain():
+                D = rt.sin(base) * 2.0
+                return float(rt.sum(D))
+
+            vals = [chain() for _ in range(4)]
+            # the injected lowering failure never corrupts results
+            assert max(vals) == min(vals)
+            rep = autotune.report()
+            assert rep["failed"], rep
+            fp = rep["failed"][0]
+            assert rep["decisions"][fp] == {"backend": "xla",
+                                            "via": "fallback"}
+            assert ledger.backend_stats(fp)["pallas"]["fallbacks"] >= 1
+            evs = events.last(10, type="backend_fallback")
+            assert any(e["fingerprint"] == fp for e in evs)
+        finally:
+            faults.configure(None)
+            faults.reset()  # re-arm from env (unset in tier-1 -> disarmed)
+
+
+# ---------------------------------------------------------------------------
+# loser evictable via the existing true-LRU compile cache
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(_MULTIPROC, reason="single-controller cache leg")
+def test_loser_executable_evictable_via_lru(clean_autotune, monkeypatch):
+    from ramba_tpu.parallel import mesh as _mesh
+
+    fuser.flush()
+    saved = dict(fuser._compile_cache)
+    fuser._compile_cache.clear()
+    fuser._cache_epoch = _mesh.mesh_epoch
+    try:
+        _forced("pallas")
+        base = rt.arange(128 * 2) / 3.0
+        rt.sync()
+        D = rt.cos(base) * 0.5
+        float(rt.sum(D))
+        del D
+        pallas_keys = [k for k in fuser._compile_cache
+                       if k and k[-1] == "pallas"]
+        assert pallas_keys, "forced pallas run left no pallas cache entry"
+        # now shrink the cache and push fresh programs through: the
+        # pallas executable is ordinary LRU freight, not pinned
+        monkeypatch.setattr(fuser, "_COMPILE_CACHE_MAX", 1)
+        autotune.reconfigure(mode="off")
+        for i in range(len(fuser._compile_cache) + 1):
+            p = fuser._Program(((f"fake-evict{i}", None, (0,)),),
+                               1, ("C",), (1,))
+            fuser._get_compiled(p, ())
+        assert all(k not in fuser._compile_cache for k in pallas_keys)
+    finally:
+        fuser._compile_cache.clear()
+        fuser._compile_cache.update(saved)
+
+
+# ---------------------------------------------------------------------------
+# race compiles ride the async compile pipeline
+# ---------------------------------------------------------------------------
+
+
+def test_submit_warm_runs_thunk_and_captures_errors():
+    from ramba_tpu.serve import pipeline as pl
+
+    p = pl.CompilePipeline()
+    try:
+        done = []
+        t = p.submit_warm(lambda: done.append(1), label="ok")
+        assert t.wait(10) == []
+        assert done == [1]
+        boom = p.submit_warm(lambda: 1 / 0, label="boom")
+        with pytest.raises(ZeroDivisionError):
+            boom.wait(10)
+    finally:
+        p.stop()
+
+
+@pytest.mark.skipif(_MULTIPROC, reason="single-controller prewarm leg")
+def test_race_prewarm_offloads_challenger_compile(clean_autotune):
+    import time as _time
+
+    from ramba_tpu.serve import pipeline as pl
+
+    autotune.reconfigure(mode="race", k=1)
+    pl.get_pipeline()  # a live pipeline arms the deferral path
+    submitted_before = _counter("autotune.prewarm_submitted")
+    done_before = _counter("autotune.prewarm_done")
+    base = rt.arange(128 * 4) / 13.0
+    rt.sync()
+
+    def chain():
+        return float(rt.sum(rt.tanh(base) * 1.5))
+
+    first = chain()
+    assert _counter("autotune.prewarm_submitted") == submitted_before + 1
+    deadline = _time.monotonic() + 30
+    while _counter("autotune.prewarm_done") < done_before + 1:
+        assert _time.monotonic() < deadline, "prewarm never completed"
+        _time.sleep(0.01)
+    # once warm, the race proceeds and every execution stays correct
+    vals = [chain() for _ in range(6)]
+    assert all(v == first for v in vals)
+
+
+# ---------------------------------------------------------------------------
+# observability wiring
+# ---------------------------------------------------------------------------
+
+
+def test_perf_report_autotune_section(clean_autotune, monkeypatch):
+    monkeypatch.setattr(pallas_backend, "supports", lambda *a: True)
+    autotune.reconfigure(mode="race", k=1)
+    fp = "fp-report"
+    _seed_exec(fp, "pallas", 0.001)
+    _seed_exec(fp, "xla", 0.002)
+    assert autotune.select(fp, None, []) == ("pallas", "autotune")
+    rep = diagnostics.perf_report()["autotune"]
+    assert rep["mode"] == "race"
+    assert rep["decisions"][fp]["backend"] == "pallas"
+    assert rep["races_latched"] >= 1
+    # off + no decisions: the section stays out of perf captures
+    autotune.reconfigure(mode="off")
+    assert "autotune" not in diagnostics.perf_report()
+
+
+def test_telemetry_exports_backend_and_autotune_series(clean_autotune,
+                                                       monkeypatch):
+    from ramba_tpu.observe import telemetry
+
+    monkeypatch.setattr(pallas_backend, "supports", lambda *a: True)
+    autotune.reconfigure(mode="race", k=1)
+    fp = "fp-telemetry"
+    _seed_exec(fp, "pallas", 0.001)
+    _seed_exec(fp, "xla", 0.002)
+    assert autotune.select(fp, None, []) == ("pallas", "autotune")
+    text = telemetry.render()
+    assert 'ramba_kernel_backend_exec_total' in text
+    assert 'backend="pallas"' in text
+    assert "ramba_autotune_decisions" in text
+    assert "ramba_autotune_races_latched_total" in text
+
+
+def test_ledger_entry_summary_has_backend_columns(clean_autotune):
+    fp = "fp-columns"
+    _seed_exec(fp, "pallas", 0.002, n=3)
+    ledger.record_execute(fp, "fake", 1, "fused", 0.5, is_new=True,
+                          backend="pallas")
+    entry = ledger.snapshot()["kernels"][fp]
+    b = entry["backends"]["pallas"]
+    assert b["exec"]["count"] == 3
+    assert b["compiles"] == 1 and b["compile_s"] >= 0.5
+    stats = ledger.backend_stats(fp)
+    assert stats["pallas"]["count"] == 3
+    assert stats["pallas"]["p50_s"] == pytest.approx(0.002)

@@ -193,13 +193,13 @@ def test_compile_cache_true_lru_with_evict_counter(monkeypatch):
         keys = [fuser._cache_key(p, ()) for p in progs]
         before = diagnostics.counters().get("fuser.cache_evict", 0)
 
-        _fn, new0, fp0 = fuser._get_compiled(progs[0], ())
+        _fn, new0, fp0, _b = fuser._get_compiled(progs[0], ())
         assert new0
-        _fn, new1, _ = fuser._get_compiled(progs[1], ())
+        _fn, new1, _, _b = fuser._get_compiled(progs[1], ())
         assert new1
-        _fn, hit0, fp0b = fuser._get_compiled(progs[0], ())  # refresh prog0
+        _fn, hit0, fp0b, _b = fuser._get_compiled(progs[0], ())  # refresh
         assert not hit0 and fp0b == fp0
-        _fn, new2, _ = fuser._get_compiled(progs[2], ())  # evicts prog1
+        _fn, new2, _, _b = fuser._get_compiled(progs[2], ())  # evicts prog1
         assert new2
 
         # FIFO would have evicted prog0 (oldest insert); true LRU keeps it
