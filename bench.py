@@ -439,6 +439,29 @@ def _bench_observe(rt, platform):
         _telemetry.render()
     out["observe_scrape_ms"] = round(
         (time.perf_counter() - t0) / scrapes * 1e3, 3)
+
+    # coherence round cost: the full agreement-round bookkeeping (epoch,
+    # event, transfer ledger) over the loopback transport — the per-round
+    # floor every coherent recovery decision pays on top of the wire.
+    from ramba_tpu.resilience import coherence as _coherence
+
+    saved_coh = os.environ.get("RAMBA_COHERENCE")
+    os.environ["RAMBA_COHERENCE"] = "force"
+    _coherence.reset()
+    try:
+        _coherence.agree("bench:coherence", 0)  # warm lazy imports
+        rounds = 2_000
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            _coherence.agree("bench:coherence", 0)
+        out["coherence_overhead_ms"] = round(
+            (time.perf_counter() - t0) / rounds * 1e3, 4)
+    finally:
+        if saved_coh is None:
+            os.environ.pop("RAMBA_COHERENCE", None)
+        else:
+            os.environ["RAMBA_COHERENCE"] = saved_coh
+        _coherence.reset()
     return out
 
 

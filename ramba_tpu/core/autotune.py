@@ -174,25 +174,21 @@ def _agree_winner(winner: str) -> str:
     lowerings would desync the SPMD program streams).  Race counts are
     ledger-driven and advance in lockstep, so all ranks reach the latch on
     the same dispatch; rank 0's measured winner becomes the decision —
-    local p50s can disagree across ranks when the backends are close."""
-    try:
-        import jax
-        if jax.process_count() <= 1:
-            return winner
-        import numpy as np
-        from jax.experimental import multihost_utils
+    local p50s can disagree across ranks when the backends are close.
 
-        v = int(multihost_utils.broadcast_one_to_all(
-            np.int32(1 if winner == PALLAS else 0)))
-        try:
-            from ramba_tpu.parallel import distributed as _distributed
+    Rides the resilience coherence layer (``coherence.agree`` with
+    ``reduce="bcast"``), which does the transfer-ledger accounting and
+    emits the ``coherence`` event itself — control-plane traffic is never
+    silently swallowed.  A failed round falls back to the local winner
+    with an ``outcome=local`` event, preserving the old best-effort
+    semantics without the old bare ``except: pass``."""
+    from ramba_tpu.resilience import coherence as _coherence
 
-            _distributed.note_transfer("broadcast", np.int32().nbytes)
-        except Exception:
-            pass
-        return PALLAS if v else XLA
-    except Exception:
+    if not _coherence.engaged():
         return winner
+    v = _coherence.agree("autotune:winner",
+                         1 if winner == PALLAS else 0, reduce="bcast")
+    return PALLAS if v else XLA
 
 
 def select(fp: str, program, leaf_vals) -> tuple:

@@ -61,6 +61,7 @@ from ramba_tpu.observe import registry as _registry
 from ramba_tpu.observe import slo as _slo
 from ramba_tpu.observe import telemetry as _telemetry
 from ramba_tpu.parallel import mesh as _mesh
+from ramba_tpu.resilience import coherence as _coherence
 from ramba_tpu.resilience import degrade as _degrade
 from ramba_tpu.resilience import elastic as _elastic
 from ramba_tpu.resilience import faults as _faults
@@ -1353,6 +1354,13 @@ def _quarantine(work: "_FlushWork", e: Exception) -> None:
     }
     if work.stream.tenant is not None:
         ev["tenant"] = work.stream.tenant
+    # Under coherent recovery the error that reached quarantine was
+    # fleet-agreed (ladder terminal decisions are agreement rounds), so
+    # every rank quarantines the same program on the same epoch; stamping
+    # the epoch lets merge-ranks pair the quarantines without guessing.
+    epoch = _coherence.last_epoch("flush:rung")
+    if epoch:
+        ev["coherence_epoch"] = epoch
     _events.emit(ev)
 
 

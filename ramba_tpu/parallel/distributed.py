@@ -144,8 +144,10 @@ def initialize(
     # record below so IT already carries the authoritative rank (and lands
     # in the right per-rank trace file).
     from ramba_tpu.observe import events as _events
+    from ramba_tpu.resilience import coherence as _coherence
 
     _events.invalidate_rank()
+    _coherence.invalidate()
     _health.record(
         outcome="ok", source="distributed_init",
         init_seconds=time.perf_counter() - t0,

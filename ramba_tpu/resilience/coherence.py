@@ -1,0 +1,351 @@
+"""Rank-coherent recovery: the consensus control plane for resilience.
+
+The SPMD execution model assumes every rank compiles and dispatches the
+*same* program.  The resilience stack, however, observes faults
+**rank-locally**: an injected (or real) failure on one rank would
+degrade that rank fused→split while its peers stay fused, the ranks'
+collective schedules mismatch, and the job hangs until the watchdog
+declares it fatal — a recoverable fault turned into a lost job.  The
+merged-timeline divergence flagging in ``trace_report.py`` *detects*
+this after the fact; this module *prevents* it.
+
+One primitive, epoch-numbered per decision site::
+
+    decision = coherence.agree(site, local_proposal)
+
+``agree`` runs a tiny cross-rank round (one ``int32`` per rank) and
+returns the same decision on every rank, in the same order: each site
+carries a monotonically increasing **epoch**, so ranks that did *not*
+observe a fault still consume decision #N of a site as their own round
+#N — the rounds pair up by construction, never by luck.  Reductions:
+
+* ``max``   (default) — "worst proposal wins".  Recovery outcomes are
+  encoded so severity is ordered (``P_OK < P_RETRY < P_DROP < P_OOM <
+  P_FATAL``): if any rank needs to drop a ladder rung, every rank drops
+  with it; if any rank hit a fatal, every rank aborts together.
+* ``min``   — "tightest budget wins" (the chunked rung's byte budget).
+* ``bcast`` — rank-0 decides (the autotune winner latch, where local
+  p50 measurements may legitimately disagree and any single choice is
+  fine as long as it is *one* choice).
+
+Decisions made mid-ladder use the **propose/decide** split: a component
+that observes something structure-changing but is not at an agreement
+point (the elastic watchdog classifying a dispatch stall) calls
+``propose(site, code)`` — rank-local, no communication — and the next
+``decide(site, local)`` round folds the pending proposal in before
+agreeing, so the signal coordinates the fleet instead of one rank
+unilaterally abandoning a rung.
+
+Wired decision sites (see docs/index.md "Rank-coherent recovery"):
+
+====================  =======================================  ========
+site                  decided by                               reduce
+====================  =======================================  ========
+``retry:<site>``      every attempt outcome in ``retry.call``  max
+``flush:rung``        every rung outcome in ``run_ladder``     max
+``memory:admit``      chunked-route admission (governor)       max
+``memory:chunk_bytes``  chunked rung per-segment byte budget   min
+``memory:oom_evict``  bytes to free after an oom-class fault   max
+``autotune:winner``   backend latched per kernel fingerprint   bcast
+====================  =======================================  ========
+
+Every round **always** accounts its bytes on the transfer ledger
+(``distributed.note_transfer("coherence", ...)``) and emits a
+``coherence`` event ``{site, epoch, proposal, decision, reduce}`` — the
+control plane is first-class traffic in the merged timelines, never
+silently swallowed.
+
+Configuration (read per call — cheap, monkeypatch-friendly):
+
+* ``RAMBA_COHERENCE``            ``on`` (default) | ``off`` | ``force``.
+  ``on`` engages only under multi-controller execution
+  (``process_count() > 1``); single-controller behavior is a byte-exact
+  no-op so tier-1 is untouched.  ``off`` disarms the whole layer —
+  a chaos/debug switch that reproduces the rank-divergence failure mode
+  (``two_process_suite --chaos-leg`` proves both directions).  ``force``
+  engages the full bookkeeping (epochs, events, ledger accounting) with
+  a loopback transport even single-process — the unit-test and bench
+  seam.
+* ``RAMBA_COHERENCE_TIMEOUT_S``  deadline for one round (default: the
+  elastic watchdog's ``RAMBA_WATCHDOG_S`` when armed, else unbounded).
+  A round that expires falls back to the *local* proposal — the peer is
+  gone and the job is likely lost anyway, but the survivor gets a
+  classified failure instead of an infinite block.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+from ramba_tpu.observe import events as _events
+from ramba_tpu.observe import registry as _registry
+
+# Recovery-outcome codes, ordered by severity so a ``max`` round is
+# "worst proposal wins".  These are the ladder/retry vocabulary; byte
+# budgets and backend ids ride the same transport as plain ints.
+P_OK = 0      # local attempt succeeded
+P_RETRY = 1   # transient failure: re-attempt in place
+P_DROP = 2    # degrade-class failure: move down one ladder rung
+P_OOM = 3     # device memory exhaustion: evict, then drop a rung
+P_FATAL = 4   # programming error / no way forward: abort everywhere
+
+_DECISION_CLASS = {P_RETRY: "retryable", P_DROP: "degrade",
+                   P_OOM: "oom", P_FATAL: "fatal"}
+_DECISION_NAME = {P_OK: "ok", P_RETRY: "retry", P_DROP: "drop",
+                  P_OOM: "oom", P_FATAL: "fatal"}
+
+_CLASS_CODE = {"retryable": P_RETRY, "degrade": P_DROP, "oom": P_OOM,
+               "fatal": P_FATAL}
+
+
+class CoherentAbort(RuntimeError):
+    """A peer rank's failure became this rank's failure: the agreement
+    round decided a severity the local attempt did not observe, and the
+    only coherent reaction is to fail the same way everywhere.
+
+    ``coherent_classification`` routes the error through
+    ``retry.classify`` (duck-typed there, like the watchdog's
+    ``stall_classification``), so a CoherentAbort degrades/aborts the
+    local ladder exactly as the remote original did on its rank."""
+
+    def __init__(self, site: str, decision: int, cause: Optional[str] = None):
+        self.site = site
+        self.decision = int(decision)
+        self.epoch = last_epoch(site)
+        self.coherent_classification = _DECISION_CLASS.get(
+            int(decision), "fatal")
+        msg = (f"coherent abort at site {site!r} epoch {self.epoch}: "
+               f"agreed decision "
+               f"{_DECISION_NAME.get(int(decision), decision)!r} "
+               f"(a peer rank's recovery outcome, consumed here so every "
+               f"rank fails identically)")
+        if cause:
+            msg += f"; local context: {cause}"
+        super().__init__(msg)
+
+
+def classification_code(cls: str) -> int:
+    """Map a retry/stall classification string to its proposal code."""
+    return _CLASS_CODE.get(cls, P_FATAL)
+
+
+def decision_class(decision: int) -> str:
+    """Map an agreed decision code back to a retry classification."""
+    return _DECISION_CLASS.get(int(decision), "fatal")
+
+
+# ---------------------------------------------------------------------------
+# state
+# ---------------------------------------------------------------------------
+
+# One lock serializes whole rounds within the process: epoch allocation
+# and the collective itself.  Cross-rank round order must match anyway
+# (the same SPMD assumption the device collectives already make); the
+# lock keeps a second thread from splicing a round into the middle of
+# another's collective.
+_round_lock = threading.RLock()
+_epochs: Dict[str, int] = {}
+_pending: Dict[str, int] = {}
+_overhead_s = 0.0
+
+_nprocs_cache: Optional[int] = None
+
+
+def invalidate() -> None:
+    """Drop the cached process count (the process group just formed or a
+    test rewired the environment)."""
+    global _nprocs_cache
+    with _round_lock:
+        _nprocs_cache = None
+
+
+def reset() -> None:
+    """Drop epochs, pending proposals, and caches (tests)."""
+    global _overhead_s, _nprocs_cache
+    with _round_lock:
+        _epochs.clear()
+        _pending.clear()
+        _overhead_s = 0.0
+        _nprocs_cache = None
+
+
+def mode() -> str:
+    raw = (os.environ.get("RAMBA_COHERENCE") or "on").strip().lower()
+    if raw in ("off", "0", "false", "no"):
+        return "off"
+    if raw == "force":
+        return "force"
+    return "on"
+
+
+def _process_count() -> int:
+    global _nprocs_cache
+    n = _nprocs_cache
+    if n is not None:
+        return n
+    try:
+        import jax
+
+        n = int(jax.process_count())
+    except Exception:
+        return 1
+    with _round_lock:
+        _nprocs_cache = n
+    return n
+
+
+def engaged() -> bool:
+    """True when agreement rounds actually run: coherence is on and the
+    job is multi-controller (or the loopback ``force`` mode is set)."""
+    m = mode()
+    if m == "off":
+        return False
+    if m == "force":
+        return True
+    return _process_count() > 1
+
+
+def _timeout_s() -> Optional[float]:
+    raw = os.environ.get("RAMBA_COHERENCE_TIMEOUT_S")
+    if raw:
+        try:
+            t = float(raw)
+            if t > 0:
+                return t
+        except ValueError:
+            pass
+    from ramba_tpu.resilience import elastic as _elastic
+
+    return _elastic.watchdog_seconds()
+
+
+def last_epoch(site: str) -> int:
+    """The epoch of the most recent round at ``site`` (0 = never)."""
+    with _round_lock:
+        return _epochs.get(site, 0)
+
+
+def epochs() -> Dict[str, int]:
+    with _round_lock:
+        return dict(_epochs)
+
+
+# ---------------------------------------------------------------------------
+# the round
+# ---------------------------------------------------------------------------
+
+
+def _transport(value: int, reduce: str) -> "tuple[int, int]":
+    """One cross-rank round over ``multihost_utils`` — the cheap
+    primitive the autotune winner broadcast proved.  Returns
+    ``(decision, nbytes)``.  Loopback (``force`` mode, single process)
+    reduces over the local proposal alone."""
+    import numpy as np
+
+    if _process_count() <= 1:
+        return int(value), np.int32().nbytes  # loopback: own proposal wins
+    from jax.experimental import multihost_utils
+
+    if reduce == "bcast":
+        out = int(multihost_utils.broadcast_one_to_all(np.int32(value)))
+        return out, int(np.int32().nbytes)
+    g = np.asarray(multihost_utils.process_allgather(np.int32(value)))
+    out = int(g.max()) if reduce == "max" else int(g.min())
+    return out, int(g.size * np.int32().nbytes)
+
+
+def agree(site: str, proposal: int, *, reduce: str = "max") -> int:
+    """Run one agreement round at ``site`` and return the fleet-wide
+    decision.  Not engaged (coherence off, or single-controller in
+    ``on`` mode): returns ``proposal`` untouched — no epoch, no event,
+    no traffic — so single-controller behavior stays byte-identical.
+
+    Engaged: allocates the site's next epoch, runs the collective under
+    the coherence deadline, accounts the round's bytes on the transfer
+    ledger, and emits a ``coherence`` event with site/epoch/proposal/
+    decision.  A round that times out (or whose transport fails) falls
+    back to the local proposal and marks the event ``outcome=local`` —
+    visible, never swallowed."""
+    if reduce not in ("max", "min", "bcast"):
+        raise ValueError(f"bad coherence reduce {reduce!r}")
+    proposal = int(proposal)
+    if not engaged():
+        return proposal
+    global _overhead_s
+    from ramba_tpu.parallel import distributed as _distributed
+    from ramba_tpu.resilience import elastic as _elastic
+
+    with _round_lock:
+        ep = _epochs.get(site, 0) + 1
+        _epochs[site] = ep
+        t0 = time.perf_counter()
+        outcome = "agreed"
+        try:
+            decision, nbytes = _elastic.with_deadline(
+                "coherence", lambda: _transport(proposal, reduce),
+                timeout_s=_timeout_s())
+        except Exception as e:
+            # The peer never joined the round (dead rank, wedged
+            # transport).  Fall back to the local proposal: the job is
+            # likely lost, but the survivor gets a classified failure
+            # path instead of an infinite block.
+            decision, nbytes = proposal, 0
+            outcome = "local"
+            _registry.inc("coherence.round_failures")
+            _events.emit({"type": "coherence", "site": site, "epoch": ep,
+                          "proposal": proposal, "decision": decision,
+                          "reduce": reduce, "outcome": outcome,
+                          "error": f"{type(e).__name__}: {e}"[:200]})
+        dt = time.perf_counter() - t0
+        _overhead_s += dt
+    _registry.inc("coherence.rounds")
+    _registry.inc(f"coherence.rounds.{site.split(':', 1)[0]}")
+    if nbytes:
+        _distributed.note_transfer("coherence", nbytes)
+    if outcome == "agreed":
+        if decision != proposal:
+            _registry.inc("coherence.overrides")
+        _events.emit({"type": "coherence", "site": site, "epoch": ep,
+                      "proposal": proposal, "decision": decision,
+                      "reduce": reduce, "ms": round(dt * 1e3, 3)})
+    return decision
+
+
+def propose(site: str, code: int) -> None:
+    """Park a rank-local proposal for ``site`` without communicating;
+    the next :func:`decide` round at the site folds it in (severity-max).
+    No-op when not engaged."""
+    if not engaged():
+        return
+    with _round_lock:
+        _pending[site] = max(_pending.get(site, 0), int(code))
+        _registry.inc("coherence.proposals")
+
+
+def decide(site: str, local: int, *, reduce: str = "max") -> int:
+    """An agreement round that first merges any pending :func:`propose`
+    signal for ``site`` into the local value (severity-max), then runs
+    :func:`agree`.  The mid-ladder decision point."""
+    if not engaged():
+        return int(local)
+    with _round_lock:
+        pend = _pending.pop(site, None)
+    if pend is not None:
+        local = max(int(local), int(pend))
+    return agree(site, local, reduce=reduce)
+
+
+def report() -> dict:
+    """Diagnostics section: mode, engagement, per-site epochs, pending
+    proposals, and cumulative round overhead."""
+    with _round_lock:
+        return {
+            "mode": mode(),
+            "engaged": engaged(),
+            "epochs": dict(_epochs),
+            "pending": dict(_pending),
+            "overhead_s": round(_overhead_s, 6),
+        }

@@ -41,6 +41,7 @@ from typing import Callable, List, Optional, Tuple
 
 from ramba_tpu.observe import events as _events
 from ramba_tpu.observe import registry as _registry
+from ramba_tpu.resilience import coherence as _coherence
 from ramba_tpu.resilience import retry as _retry
 
 #: Canonical rung order for the flush ladder.
@@ -61,10 +62,24 @@ def run_ladder(site: str, rungs: List[Tuple[str, Callable]], *,
     ``tags`` (e.g. ``{"tenant": ...}`` from a serving session) ride on
     every degrade event so the degradation timeline attributes to a
     tenant; None adds nothing, keeping historical events byte-identical.
+
+    Under multi-controller execution with the coherence layer engaged,
+    every rung outcome runs through a ``flush:rung`` agreement round
+    (severity-max — the worst rung proposed by any rank wins): a rank
+    whose attempt succeeded still drops with the fleet when a peer
+    failed, so the ranks' collective schedules never diverge; a fatal
+    (or donation-exhausted) outcome anywhere aborts everywhere with the
+    same classification instead of one error and one hang.
+    Single-controller the agreement is a byte-exact no-op.
     """
+    coh = _coherence.engaged()
+    rsite = f"{site}:rung"
+    n = len(rungs)
     last: Optional[Exception] = None
     prev_name: Optional[str] = None
-    for i, (name, thunk) in enumerate(rungs):
+    i = 0
+    while i < n:
+        name, thunk = rungs[i]
         if i > 0:
             _registry.inc("resilience.degrade_steps")
             _registry.inc(f"resilience.degrade.{name}")
@@ -72,34 +87,72 @@ def run_ladder(site: str, rungs: List[Tuple[str, Callable]], *,
                           "from": prev_name, "to": name,
                           "error": _retry._errstr(last) if last else None,
                           **(tags or {})})
-        try:
-            out = _retry.call(site, thunk)
-        except Exception as e:
-            cls = _retry.classify(e)
-            if cls == "fatal":
-                raise
-            if leaf_check is not None and not leaf_check():
-                # Donated inputs are gone; a lower rung would recompute
-                # from deleted buffers.  Surface the real failure.
-                raise
-            if cls == "oom":
-                # Device memory exhaustion: free HBM before the next rung
-                # runs — eviction is the recovery, the rung drop is the
-                # insurance.
-                try:
-                    from ramba_tpu.resilience import memory as _memory
+        out = None
+        err: Optional[Exception] = None
+        my = _coherence.P_OK
+        if coh and i > 0 and leaf_check is not None and not leaf_check():
+            # A locally-successful earlier attempt consumed this rank's
+            # donated inputs, but the fleet agreed to drop anyway (a peer
+            # failed).  This rank cannot run the lower rung — propose a
+            # coherent abort so every rank surfaces the same terminal
+            # error instead of one error and one hang.
+            err = last if last is not None else RuntimeError(
+                f"{site}: donated inputs consumed before rung {name!r}")
+            my = _coherence.P_FATAL
+        else:
+            try:
+                out = _retry.call(site, thunk, coherent=coh)
+            except Exception as e:
+                err = e
+                cls = _retry.classify(e)
+                if cls == "fatal":
+                    if not coh:
+                        raise
+                    my = _coherence.P_FATAL
+                elif leaf_check is not None and not leaf_check():
+                    # Donated inputs are gone; a lower rung would recompute
+                    # from deleted buffers.  Surface the real failure.
+                    if not coh:
+                        raise
+                    my = _coherence.P_FATAL
+                else:
+                    my = _coherence.P_OOM if cls == "oom" \
+                        else _coherence.P_DROP
+        decision = _coherence.decide(rsite, my) if coh else my
+        if decision == _coherence.P_OK:
+            if i > 0:
+                _registry.inc("resilience.degrade_recovered")
+                _events.emit({"type": "degrade", "site": site,
+                              "action": "recovered", "rung": name,
+                              **(tags or {})})
+            return out, name
+        if decision == _coherence.P_OOM:
+            # Device memory exhaustion: free HBM before the next rung
+            # runs — eviction is the recovery, the rung drop is the
+            # insurance.  Coherent: every rank evicts, not just the one
+            # that observed the OOM.
+            try:
+                from ramba_tpu.resilience import memory as _memory
 
-                    _memory.evict_for_oom(e)
-                except Exception:
-                    pass
-            last = e
-            prev_name = name
-            continue
-        if i > 0:
-            _registry.inc("resilience.degrade_recovered")
-            _events.emit({"type": "degrade", "site": site,
-                          "action": "recovered", "rung": name,
-                          **(tags or {})})
-        return out, name
-    assert last is not None
-    raise last
+                _memory.evict_for_oom(
+                    err if err is not None
+                    else _coherence.CoherentAbort(rsite, decision))
+            except Exception:
+                pass
+        if decision >= _coherence.P_FATAL or i + 1 >= n:
+            # The raised class must match the agreed decision on every
+            # rank (coherent terminal = identical classification fleet-
+            # wide); the local error surfaces directly when it already
+            # is that class, otherwise it rides as the abort's cause.
+            if err is not None and (not coh or _retry.classify(err) ==
+                                    _coherence.decision_class(decision)):
+                raise err
+            raise _coherence.CoherentAbort(
+                rsite, decision,
+                cause=_retry._errstr(err) if err is not None else None)
+        last = err if err is not None \
+            else _coherence.CoherentAbort(rsite, decision)
+        prev_name = name
+        i += 1
+    raise last if last is not None else RuntimeError(
+        f"{site}: empty ladder")

@@ -71,6 +71,7 @@ import numpy as np
 from ramba_tpu.observe import events as _events
 from ramba_tpu.observe import health as _health
 from ramba_tpu.observe import registry as _registry
+from ramba_tpu.resilience import coherence as _coherence
 from ramba_tpu.resilience import faults as _faults
 from ramba_tpu.resilience import memory as _memory
 
@@ -207,6 +208,13 @@ def with_deadline(site: str, fn: Callable, *,
                       "deadline_s": t, "classification": cls})
         _health.record(outcome="error", source=f"watchdog:{site}",
                        error=f"stall after {waited:.3f}s")
+        if site == "dispatch" and _coherence.engaged():
+            # Seed the ladder's next flush:rung agreement round with the
+            # stall's severity so the fleet degrades (or aborts) together
+            # instead of this rank unilaterally abandoning the rung.
+            _coherence.propose(
+                "flush:rung",
+                _coherence.P_FATAL if cls == "fatal" else _coherence.P_DROP)
         raise RankStallError(site, waited, cls, rank=_rank())
     if "error" in box:
         raise box["error"]

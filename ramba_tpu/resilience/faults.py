@@ -36,6 +36,16 @@ they fire on every check.  ``dispatch:hang:ms=500:after=2`` hangs the
 third dispatch only, which is how the watchdog and heartbeat-miss
 tests seed a stall without flaky timing.
 
+Every spec additionally accepts a ``rank=<i>`` *payload* (composes with
+``after=<k>``, ``ms=<n>``, ``bytes=<n>`` and every mode): the spec only
+*fires* on SPMD rank ``i`` (``jax.process_index()``), while the per-site
+call counter still advances on every rank — so ``after=``/count/
+probability schedules stay rank-aligned and only the injection itself
+is skewed.  ``dispatch:0.3:rank=1`` faults ~30% of rank 1's dispatches
+and none of rank 0's — the rank-skewed chaos the coherence layer
+(``resilience/coherence.py``) must absorb without divergence.
+Single-process, ``rank=0`` fires and any other rank disarms the spec.
+
 Sites are free-form strings; the ones wired into the codebase are
 ``compile``, ``execute``, ``oom``, ``eager``, ``host``, ``rewrite``,
 ``checkpoint_io``, ``fileio``, ``init_connect``, ``dispatch`` (checked
@@ -110,13 +120,14 @@ class InjectedFatalFault(InjectedFault):
 
 class _Spec:
     __slots__ = ("site", "mode", "kind", "n", "p", "nbytes", "delay_ms",
-                 "after_n", "calls", "fired")
+                 "after_n", "rank_i", "calls", "fired")
 
     def __init__(self, site: str, mode: str, kind: str,
                  n: Optional[int] = None, p: Optional[float] = None,
                  nbytes: Optional[int] = None,
                  delay_ms: Optional[float] = None,
-                 after_n: Optional[int] = None):
+                 after_n: Optional[int] = None,
+                 rank_i: Optional[int] = None):
         self.site = site
         # "once" | "always" | "count" | "after" | "prob" | "delay" | "hang"
         self.mode = mode
@@ -126,6 +137,7 @@ class _Spec:
         self.nbytes = nbytes  # simulated allocation size for oom kinds
         self.delay_ms = delay_ms  # sleep length for delay/hang modes
         self.after_n = after_n    # one-shot trigger for delay/hang modes
+        self.rank_i = rank_i      # fire on this SPMD rank only (None = all)
         self.calls = 0
         self.fired = 0
 
@@ -145,9 +157,22 @@ def _parse_one(chunk: str) -> _Spec:
     nbytes: Optional[int] = None
     delay_ms: Optional[float] = None
     after_n: Optional[int] = None
+    rank_i: Optional[int] = None
     for extra in parts[2:]:
         extra = extra.strip().lower()
-        if extra.startswith("after="):
+        if extra.startswith("rank="):
+            if rank_i is not None:
+                raise ValueError(
+                    f"bad RAMBA_FAULTS spec {chunk!r}: duplicate rank=")
+            try:
+                rank_i = int(extra[len("rank="):])
+            except ValueError:
+                raise ValueError(
+                    f"bad RAMBA_FAULTS rank= payload in {chunk!r}") from None
+            if rank_i < 0:
+                raise ValueError(
+                    f"negative RAMBA_FAULTS rank= payload in {chunk!r}")
+        elif extra.startswith("after="):
             if after_n is not None:
                 raise ValueError(
                     f"bad RAMBA_FAULTS spec {chunk!r}: duplicate after=")
@@ -197,7 +222,8 @@ def _parse_one(chunk: str) -> _Spec:
         if delay_ms is None:
             raise ValueError(
                 f"bad RAMBA_FAULTS spec {chunk!r}: {mode} needs ms=<n>")
-        return _Spec(site, mode, mode, delay_ms=delay_ms, after_n=after_n)
+        return _Spec(site, mode, mode, delay_ms=delay_ms, after_n=after_n,
+                     rank_i=rank_i)
     if delay_ms is not None:
         raise ValueError(
             f"bad RAMBA_FAULTS spec {chunk!r}: ms= only valid with "
@@ -209,12 +235,12 @@ def _parse_one(chunk: str) -> _Spec:
     if not kind:
         kind = "oom" if site == "oom" else "transient"
     if mode == "once":
-        return _Spec(site, "once", kind, nbytes=nbytes)
+        return _Spec(site, "once", kind, nbytes=nbytes, rank_i=rank_i)
     if mode == "always":
-        return _Spec(site, "always", kind, nbytes=nbytes)
+        return _Spec(site, "always", kind, nbytes=nbytes, rank_i=rank_i)
     if mode.startswith("after="):
         return _Spec(site, "after", kind, n=int(mode[len("after="):]),
-                     nbytes=nbytes)
+                     nbytes=nbytes, rank_i=rank_i)
     try:
         n = int(mode)
     except ValueError:
@@ -222,14 +248,14 @@ def _parse_one(chunk: str) -> _Spec:
     else:
         if n < 0:
             raise ValueError(f"bad RAMBA_FAULTS count in {chunk!r}")
-        return _Spec(site, "count", kind, n=n, nbytes=nbytes)
+        return _Spec(site, "count", kind, n=n, nbytes=nbytes, rank_i=rank_i)
     try:
         p = float(mode)
     except ValueError:
         raise ValueError(f"bad RAMBA_FAULTS mode {mode!r} in {chunk!r}") from None
     if not 0.0 <= p <= 1.0:
         raise ValueError(f"RAMBA_FAULTS probability out of [0,1] in {chunk!r}")
-    return _Spec(site, "prob", kind, p=p, nbytes=nbytes)
+    return _Spec(site, "prob", kind, p=p, nbytes=nbytes, rank_i=rank_i)
 
 
 def _parse(spec: Optional[str], strict: bool = True) -> Dict[str, _Spec]:
@@ -303,6 +329,15 @@ def _should_fire(sp: _Spec) -> bool:
     return rng.random() < (sp.p or 0.0)
 
 
+def _process_index() -> int:
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
 def check(site: str, **ctx) -> None:
     """Raise an injected fault if the plan says this check should fail.
 
@@ -316,6 +351,10 @@ def check(site: str, **ctx) -> None:
         if sp is None:
             return
         sp.calls += 1
+        if sp.rank_i is not None and sp.rank_i != _process_index():
+            # rank-skewed spec: the call counter advances on every rank
+            # (schedules stay aligned) but only the target rank fires
+            return
         if not _should_fire(sp):
             return
         sp.fired += 1

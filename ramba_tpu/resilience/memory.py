@@ -60,6 +60,7 @@ from typing import Optional
 from ramba_tpu import common as _common
 from ramba_tpu.observe import events as _events
 from ramba_tpu.observe import registry as _registry
+from ramba_tpu.resilience import coherence as _coherence
 from ramba_tpu.resilience import spill as _spill
 
 
@@ -151,17 +152,29 @@ def chunk_target_bytes() -> int:
     """Per-segment live-byte target for the ``chunked`` rung.  Derived
     from the watermark when a budget is known; otherwise
     ``RAMBA_CHUNK_BYTES`` (default 256 MiB) so the rung still works as a
-    plain ladder fallback on budgetless backends."""
+    plain ladder fallback on budgetless backends.
+
+    The chunk budget determines segment boundaries — program structure —
+    so under coherent multi-controller execution it is min-agreed across
+    ranks (tightest budget wins) before anyone cuts a segment."""
     raw = os.environ.get("RAMBA_CHUNK_BYTES")
+    target = None
     if raw:
         try:
-            return max(1, _common.parse_bytes(raw))
+            target = max(1, _common.parse_bytes(raw))
         except ValueError:
             pass
-    b = budget_bytes()
-    if b:
-        return max(1 << 16, (watermark_bytes(b) or b) // 4)
-    return 256 << 20
+    if target is None:
+        b = budget_bytes()
+        if b:
+            target = max(1 << 16, (watermark_bytes(b) or b) // 4)
+        else:
+            target = 256 << 20
+    if _coherence.engaged():
+        # 64 KiB granularity keeps byte counts inside the int32 transport.
+        target = max(1 << 16, _coherence.agree(
+            "memory:chunk_bytes", target >> 16, reduce="min") << 16)
+    return target
 
 
 # ---------------------------------------------------------------------------
@@ -666,6 +679,17 @@ def admit(program, leaf_vals, donate_key, span: Optional[dict] = None, *,
         if _admit_tenant(program, leaf_vals, donate_key, span, tenant,
                          int(quota)):
             route = True
+    if _coherence.engaged() and (budget_bytes() is not None
+                                 or (tenant is not None and quota)):
+        # Routing to chunked changes program structure; when any rank's
+        # governor is armed, all ranks agree (chunked anywhere → chunked
+        # everywhere).  Budgetless, quota-less flushes skip the round so
+        # the healthy CPU path stays collective-free.
+        agreed = bool(_coherence.agree("memory:admit",
+                                       1 if route else 0, reduce="max"))
+        if agreed and not route and span is not None:
+            span["admission"] = "coherent"
+        route = agreed
     return route
 
 
@@ -684,6 +708,14 @@ def evict_for_oom(exc: BaseException) -> int:
             need = int(m.group(1) or m.group(2))
     if not need:
         need = ledger.live_bytes or 1
+    if _coherence.engaged():
+        # Evictions change which buffers are resident — structure the
+        # next rung depends on — so the need is max-agreed: every rank
+        # frees at least what the worst-off rank asked for (ceil to the
+        # 64 KiB transport granularity so small needs never round to 0).
+        need = max(1, _coherence.agree(
+            "memory:oom_evict", (int(need) + 0xFFFF) >> 16,
+            reduce="max") << 16)
     freed = ledger.evict_until(int(need))
     _events.emit({
         "type": "memory", "action": "oom_evict", "need_bytes": int(need),

@@ -38,6 +38,7 @@ from typing import Callable, Optional
 from ramba_tpu.observe import events as _events
 from ramba_tpu.observe import health as _health
 from ramba_tpu.observe import registry as _registry
+from ramba_tpu.resilience import coherence as _coherence
 from ramba_tpu.resilience import faults as _faults
 
 
@@ -74,6 +75,12 @@ def classify(exc: BaseException) -> str:
     ``"fatal"`` (propagate unchanged)."""
     if isinstance(exc, RetryBudgetExhausted):
         return "degrade"
+    # Coherent aborts (coherence.CoherentAbort) carry the fleet-agreed
+    # class: a peer's failure consumed here must route exactly as the
+    # original did on its rank.
+    agreed = getattr(exc, "coherent_classification", None)
+    if agreed in ("retryable", "degrade", "oom", "fatal"):
+        return agreed
     # Watchdog stalls (elastic.RankStallError) carry their routing with
     # them — duck-typed on the attribute so this module needs no elastic
     # import (elastic imports retry's sibling modules).
@@ -167,7 +174,7 @@ def _errstr(exc: BaseException) -> str:
 
 
 def call(site: str, fn: Callable, *, on_retry: Optional[Callable] = None,
-         policy: Optional[RetryPolicy] = None):
+         policy: Optional[RetryPolicy] = None, coherent: bool = False):
     """Run ``fn()`` under the site's retry policy.
 
     Retryable failures back off and re-attempt (running ``on_retry``
@@ -175,7 +182,17 @@ def call(site: str, fn: Callable, *, on_retry: Optional[Callable] = None,
     else propagates unchanged.  When the budget runs out the last error
     is chained under :class:`RetryBudgetExhausted`.  A recovery after
     ≥1 retry is recorded in the health stream.
+
+    ``coherent=True`` (the degradation ladder passes it) runs every
+    attempt outcome through a cross-rank agreement round when the
+    coherence layer is engaged: attempt counts advance in lockstep, a
+    retry anywhere is a retry everywhere, and the terminal
+    degrade-vs-oom-vs-fatal classification is fleet-agreed — one rank's
+    failure can no longer leave its peers' collective schedules behind.
+    Single-controller (or coherence off) the flag is inert.
     """
+    if coherent and _coherence.engaged():
+        return _call_coherent(site, fn, on_retry=on_retry, policy=policy)
     pol = policy or policy_for(site)
     attempt = 0
     while True:
@@ -212,3 +229,78 @@ def call(site: str, fn: Callable, *, on_retry: Optional[Callable] = None,
         if attempt > 1:
             _health.record_recovery(site, attempt - 1)
         return out
+
+
+def _call_coherent(site: str, fn: Callable, *,
+                   on_retry: Optional[Callable] = None,
+                   policy: Optional[RetryPolicy] = None):
+    """The coherent variant of :func:`call`: one agreement round per
+    attempt at ``retry:<site>``, severity-max.  Every rank participates
+    in every round — a rank whose attempt succeeded keeps its result and
+    proposes ``P_OK``, but still consumes the round, so a peer's failure
+    pulls the whole fleet through the same retry/degrade/abort sequence
+    (same attempt numbers, same backoff sleeps, same terminal class)."""
+    pol = policy or policy_for(site)
+    rsite = f"retry:{site}"
+    attempt = 0
+    done = False
+    out = None
+    err: Optional[Exception] = None
+    while True:
+        attempt += 1
+        if not done:
+            err = None
+            try:
+                out = fn()
+                done = True
+            except Exception as e:
+                err = e
+        if err is None:
+            my = _coherence.P_OK
+        else:
+            cls = classify(err)
+            if cls == "retryable":
+                my = _coherence.P_RETRY if attempt < pol.attempts \
+                    else _coherence.P_DROP
+            else:
+                my = _coherence.classification_code(cls)
+        d = _coherence.decide(rsite, my)
+        if d == _coherence.P_OK:
+            if attempt > 1:
+                _health.record_recovery(site, attempt - 1)
+            return out
+        if d == _coherence.P_RETRY:
+            delay = pol.delay(site, attempt)
+            _registry.inc("resilience.retries")
+            _registry.inc(f"resilience.retries.{site}")
+            _events.emit({"type": "degrade", "site": site, "action": "retry",
+                          "attempt": attempt, "delay_s": round(delay, 4),
+                          "error": _errstr(err) if err is not None else None})
+            if err is not None and on_retry is not None:
+                try:
+                    on_retry()
+                except Exception:
+                    pass
+            if delay > 0:
+                # every rank sleeps the (deterministic) backoff, failed or
+                # not, so the fleet re-enters the next round together
+                time.sleep(delay)
+            continue
+        # Terminal: every rank raises the agreed class together.
+        if my == _coherence.P_DROP and err is not None \
+                and classify(err) == "retryable":
+            # this rank's own budget ran out — surface it the historical
+            # way, chained under RetryBudgetExhausted (classified degrade)
+            _registry.inc("resilience.retry_exhausted")
+            _registry.inc(f"resilience.retry_exhausted.{site}")
+            _events.emit({"type": "degrade", "site": site,
+                          "action": "exhausted", "attempts": attempt,
+                          "error": _errstr(err)})
+            raise RetryBudgetExhausted(
+                f"{site}: {attempt} attempt(s) failed; retry budget "
+                f"exhausted (last: {_errstr(err)})"
+            ) from err
+        if err is not None and classify(err) == _coherence.decision_class(d):
+            raise err  # the local failure IS the agreed failure
+        raise _coherence.CoherentAbort(
+            rsite, d, cause=_errstr(err) if err is not None else None)

@@ -52,6 +52,7 @@ _METRIC_DIRECTION = {
     "observe_events_per_s": "higher",
     "observe_flush_overhead_pct": "lower",
     "observe_scrape_ms": "lower",
+    "coherence_overhead_ms": "lower",   # loopback agreement-round floor
 }
 
 

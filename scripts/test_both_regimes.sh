@@ -24,3 +24,5 @@ echo "=== leg 9: live telemetry (2-rank exporters, shared cross-rank trace) ==="
 python scripts/two_process_suite.py --telemetry-leg
 echo "=== leg 10: backend autotune race (2-rank, same backend latched per fingerprint) ==="
 python scripts/two_process_suite.py --autotune-leg
+echo "=== leg 11: 2-process rank-skewed chaos soak (coherent recovery) ==="
+python scripts/two_process_suite.py --chaos-leg

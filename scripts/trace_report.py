@@ -493,6 +493,13 @@ def _merge_line(e: dict) -> str:
         return (f"STALL     {e.get('site', '?')}"
                 f" waited={e.get('waited_s', '?')}s"
                 f" class={e.get('classification', '?')}")
+    if t == "coherence":
+        line = (f"coherence {e.get('site', '?')}"
+                f" epoch={e.get('epoch', '?')}"
+                f" {e.get('proposal', '?')}->{e.get('decision', '?')}")
+        if e.get("outcome") == "local":
+            line += " LOCAL-FALLBACK"
+        return line
     if t == "lifecycle":
         line = f"lifecycle {e.get('phase', '?')}"
         if e.get("step") is not None:
@@ -571,7 +578,7 @@ def merge_report(path: str, per_rank: dict, file=None, cap: int = 80) -> None:
         t = e.get("type")
         if t in ("fault", "degrade", "slow_flush", "cache_evict",
                  "flush_error", "health", "serve_coalesce", "stall",
-                 "lifecycle"):
+                 "lifecycle", "coherence"):
             return True
         if t == "memory":
             return not (e.get("action") == "admit" and e.get("ok"))

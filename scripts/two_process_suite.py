@@ -69,6 +69,22 @@ into ONE fingerprint-matched batch on both ranks; the runner asserts
 the coalesced fingerprint AND the full kernel-ledger key sets are
 identical across ranks.
 
+``--chaos-leg`` runs the rank-coherent-recovery acceptance leg: a
+2-rank SPMD soak where EVERY fault is injected on rank 1 only
+(``RAMBA_FAULTS`` ``rank=1`` payloads across the dispatch/execute/oom
+sites, seeded), plus one deterministic mid-run fatal burst that drives
+a coherent quarantine.  Phase ON (``RAMBA_COHERENCE=on``) asserts the
+consensus control plane absorbs the skew: byte-identical per-iteration
+results on both ranks, identical coherence decision sequences (same
+sites, same epochs, same decisions), identical rung-transition and
+retry sequences, equal quarantine counts (each stamped with its
+agreement epoch), zero watchdog ``stall`` events, and zero
+local-fallback rounds.  Phase OFF re-runs the same seed with
+``RAMBA_COHERENCE=off`` and asserts the historical failure mode comes
+back: rank-local recovery diverges the rungs, the ranks' host gathers
+mispair, and the run ends in differing results / a wedged rank
+(deadline-killed) — demonstrating the protocol is what fixes it.
+
 ``--telemetry-leg`` runs the live-telemetry acceptance leg: both ranks
 serve a traced ``serve.Session`` flush (one FIXED trace_id shared across
 ranks — the cross-rank causal chain), start the Prometheus exporter on
@@ -992,6 +1008,253 @@ def run_memory_leg() -> int:
     return 0 if ok else 1
 
 
+# SPMD workload for the chaos leg: ~two dozen elementwise flush+gather
+# iterations under rank-1-only fault injection.  Elementwise programs
+# keep the degradation ladder communication-free (no collective inside a
+# rung can wedge the healthy rank mid-attempt); the only collectives are
+# the coherence agreement rounds and the post-flush all-gather — so with
+# coherence ON a terminal failure anywhere makes BOTH ranks skip the
+# gather together, and with coherence OFF the skew mispairs the gathers,
+# which is exactly the historical failure mode.  Iteration FATAL_AT
+# swaps in a one-shot fatal injection (coherent quarantine everywhere);
+# errors are printed by their *agreed classification* (retry.classify),
+# which is the cross-rank-comparable name for a failure.
+# argv: <rank> <coordinator>.
+_CHAOS_WORKLOAD = """
+import hashlib
+import os
+import sys
+import numpy as np
+rank, coord = int(sys.argv[1]), sys.argv[2]
+from ramba_tpu.parallel import distributed
+distributed.initialize(coordinator_address=coord, num_processes=2,
+                       process_id=rank)
+import jax
+assert jax.process_count() == 2, jax.process_count()
+import ramba_tpu as rt
+from ramba_tpu.resilience import faults, retry
+
+N = 4096
+ITERS = 24
+FATAL_AT = 18
+base_spec = os.environ.get('RAMBA_FAULTS')
+for i in range(ITERS):
+    if i == FATAL_AT:
+        faults.configure('execute:1:fatal:rank=1')
+    elif i == FATAL_AT + 1:
+        faults.configure(base_spec)
+    try:
+        a = (rt.arange(N) + float(i)) * 2.0 + 1.0
+        b = a * a - 3.0 * a
+        v = b.asarray()
+        ref = (np.arange(N) + float(i)) * 2.0 + 1.0
+        ref = ref * ref - 3.0 * ref
+        good = 'ok' if np.allclose(v, ref, rtol=1e-5) else 'BAD'
+        line = 'i=%02d sha=%s %s' % (
+            i, hashlib.sha256(v.tobytes()).hexdigest()[:16], good)
+        del a, b, v
+    except Exception as e:
+        line = 'i=%02d err=%s' % (i, retry.classify(e))
+    print('CHAOS_RESULT ' + line, flush=True)
+print('CHAOS_DONE rank=%d' % rank, flush=True)
+"""
+
+
+def _chaos_env(basetemp: str, trace_base: str, coherence: str) -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    for k in ("RAMBA_TEST_PROCS", "RAMBA_TEST_PROC_ID",
+              "RAMBA_TEST_COORD", "RAMBA_TEST_SHARED_TMP",
+              "RAMBA_PROFILE_DIR"):
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    # Every fault targets rank 1 only — the skew the protocol must absorb.
+    env["RAMBA_FAULTS"] = ("dispatch:0.25:rank=1,execute:0.15:rank=1,"
+                           "oom:0.1:rank=1:bytes=1m")
+    env["RAMBA_FAULTS_SEED"] = "1234"
+    env["RAMBA_RETRY_BASE_S"] = "0.01"
+    env["RAMBA_WATCHDOG_S"] = "45"  # tripwire: ON phase must never trip it
+    env["RAMBA_COHERENCE"] = coherence
+    env["RAMBA_TRACE"] = trace_base
+    return env
+
+
+def _chaos_run(basetemp: str, trace_base: str, coherence: str,
+               budget: float, grace: float = 30.0):
+    """Launch both ranks, wait with a straggler grace window (once one
+    rank exits, the other gets ``grace`` seconds before the kill — the
+    OFF phase intentionally wedges a rank and must not eat the full
+    budget).  Returns per-rank return codes (-9 = killed)."""
+    procs, logs = [], []
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    for rank in range(2):
+        env = _chaos_env(basetemp, trace_base, coherence)
+        log = open(os.path.join(basetemp, f"{coherence}.rank{rank}.log"),
+                   "w")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _CHAOS_WORKLOAD, str(rank),
+             f"localhost:{port}"],
+            env=env, stdout=log, stderr=subprocess.STDOUT, cwd=REPO,
+        ))
+    deadline = time.time() + budget
+    shrunk = False
+    rcs = [None, None]
+    try:
+        while any(rc is None for rc in rcs) and time.time() < deadline:
+            for i, p in enumerate(procs):
+                if rcs[i] is None and p.poll() is not None:
+                    rcs[i] = p.returncode
+            if not shrunk and sum(rc is not None for rc in rcs) == 1:
+                deadline = min(deadline, time.time() + grace)
+                shrunk = True
+            time.sleep(0.25)
+        for i, p in enumerate(procs):
+            if rcs[i] is None:
+                p.kill()
+                p.wait()
+                rcs[i] = -9
+    finally:
+        for log in logs:
+            log.close()
+    return rcs
+
+
+def _chaos_events(trace_base: str, rank: int) -> list:
+    import json
+
+    path = f"{trace_base}.rank{rank}"
+    try:
+        with open(path) as f:
+            return [json.loads(ln) for ln in f if ln.strip()]
+    except (OSError, ValueError):
+        return []
+
+
+def _chaos_results(basetemp: str, coherence: str, rank: int) -> list:
+    path = os.path.join(basetemp, f"{coherence}.rank{rank}.log")
+    try:
+        with open(path) as f:
+            return [ln.strip() for ln in f
+                    if ln.startswith("CHAOS_RESULT ")]
+    except OSError:
+        return []
+
+
+def run_chaos_leg() -> int:
+    """Rank-skewed chaos soak: coherence ON must hold the fleet in
+    lockstep; coherence OFF (same seed) must reproduce the historical
+    divergence failure mode."""
+    basetemp = tempfile.mkdtemp(prefix="ramba_2proc_chaos_")
+    budget = float(os.environ.get("RAMBA_TEST_PROCS_TIMEOUT", "600"))
+    ok = True
+
+    # ---- phase ON: the protocol absorbs the skew -----------------------
+    trace_on = os.path.join(basetemp, "trace_on.jsonl")
+    rcs = _chaos_run(basetemp, trace_on, "on", budget)
+    if rcs != [0, 0]:
+        print(f"chaos leg ON: FAIL (rcs={rcs}, expected clean exits)")
+        ok = False
+    res = [_chaos_results(basetemp, "on", r) for r in range(2)]
+    if not res[0] or res[0] != res[1]:
+        print(f"chaos leg ON: FAIL (per-iteration results diverge: "
+              f"rank0={len(res[0])} lines, rank1={len(res[1])} lines)")
+        for l0, l1 in zip(res[0], res[1]):
+            if l0 != l1:
+                print(f"  rank0: {l0}\n  rank1: {l1}")
+        ok = False
+    if any("BAD" in ln for ln in res[0] + res[1]):
+        print("chaos leg ON: FAIL (numerically wrong result)")
+        ok = False
+    evs = [_chaos_events(trace_on, r) for r in range(2)]
+    coh_seq = [[(e.get("site"), e.get("epoch"), e.get("decision"))
+                for e in evs[r] if e.get("type") == "coherence"]
+               for r in range(2)]
+    rung_seq = [[(e.get("site"), e.get("from"), e.get("to"))
+                 for e in evs[r] if e.get("type") == "degrade"
+                 and e.get("action") == "rung"] for r in range(2)]
+    retry_seq = [[(e.get("site"), e.get("action"), e.get("attempt"))
+                  for e in evs[r] if e.get("type") == "degrade"
+                  and e.get("action") in ("retry", "exhausted")]
+                 for r in range(2)]
+    quar = [[e for e in evs[r] if e.get("type") == "flush_error"]
+            for r in range(2)]
+    stalls = [sum(1 for e in evs[r] if e.get("type") == "stall")
+              for r in range(2)]
+    local_rounds = [sum(1 for e in evs[r] if e.get("type") == "coherence"
+                        and e.get("outcome") == "local") for r in range(2)]
+    faults_fired = [sum(1 for e in evs[r] if e.get("type") == "fault")
+                    for r in range(2)]
+    overrides = sum(1 for e in evs[0] if e.get("type") == "coherence"
+                    and e.get("decision") != e.get("proposal"))
+    print(f"chaos leg ON: {len(coh_seq[0])}/{len(coh_seq[1])} coherence "
+          f"rounds, {len(rung_seq[0])}/{len(rung_seq[1])} rung drops, "
+          f"{len(retry_seq[0])}/{len(retry_seq[1])} retries, "
+          f"{len(quar[0])}/{len(quar[1])} quarantines, "
+          f"faults r0/r1={faults_fired[0]}/{faults_fired[1]}, "
+          f"rank0 dragged {overrides}x")
+    for name, seq in (("coherence", coh_seq), ("rung", rung_seq),
+                      ("retry", retry_seq)):
+        if not seq[0] or seq[0] != seq[1]:
+            print(f"chaos leg ON: FAIL ({name} decision sequences differ "
+                  f"or empty: {len(seq[0])} vs {len(seq[1])})")
+            ok = False
+    if len(quar[0]) != len(quar[1]) or not quar[0]:
+        print(f"chaos leg ON: FAIL (quarantines {len(quar[0])} vs "
+              f"{len(quar[1])}, expected equal and >= 1)")
+        ok = False
+    elif not all(e.get("coherence_epoch") for e in quar[0] + quar[1]):
+        print("chaos leg ON: FAIL (quarantine missing coherence_epoch)")
+        ok = False
+    if stalls != [0, 0]:
+        print(f"chaos leg ON: FAIL (stall events {stalls}, expected zero)")
+        ok = False
+    if local_rounds != [0, 0]:
+        print(f"chaos leg ON: FAIL (local-fallback rounds {local_rounds})")
+        ok = False
+    if faults_fired[0] != 0 or faults_fired[1] == 0:
+        print(f"chaos leg ON: FAIL (fault skew wrong: {faults_fired})")
+        ok = False
+    if overrides == 0:
+        print("chaos leg ON: FAIL (rank 0 never overridden — the soak "
+              "exercised no skew)")
+        ok = False
+
+    # ---- phase OFF: same seed, no protocol → divergence comes back -----
+    trace_off = os.path.join(basetemp, "trace_off.jsonl")
+    off_rcs = _chaos_run(basetemp, trace_off, "off",
+                         min(budget, 150.0), grace=20.0)
+    off_res = [_chaos_results(basetemp, "off", r) for r in range(2)]
+    off_evs = [_chaos_events(trace_off, r) for r in range(2)]
+    off_rungs = [[(e.get("site"), e.get("from"), e.get("to"))
+                  for e in off_evs[r] if e.get("type") == "degrade"
+                  and e.get("action") == "rung"] for r in range(2)]
+    off_stalls = sum(1 for r in range(2) for e in off_evs[r]
+                     if e.get("type") == "stall")
+    diverged = (off_rcs != [0, 0] or off_res[0] != off_res[1]
+                or off_rungs[0] != off_rungs[1] or off_stalls > 0)
+    print(f"chaos leg OFF: rcs={off_rcs}, result lines "
+          f"{len(off_res[0])}/{len(off_res[1])} "
+          f"(identical={off_res[0] == off_res[1]}), rung drops "
+          f"{len(off_rungs[0])}/{len(off_rungs[1])}, stalls={off_stalls}")
+    if not diverged:
+        print("chaos leg OFF: FAIL (coherence off did NOT reproduce the "
+              "divergence — the ON-phase result proves nothing)")
+        ok = False
+    else:
+        print("chaos leg OFF: divergence reproduced (expected)")
+
+    print(f"two-process chaos leg: {'OK' if ok else 'FAIL'}")
+    if ok:
+        shutil.rmtree(basetemp, ignore_errors=True)
+    else:
+        print(f"chaos leg artifacts kept at {basetemp}")
+    return 0 if ok else 1
+
+
 def run_fault_leg() -> int:
     """Two ranks, one injected compile fault each; both must recover."""
     with socket.socket() as s:
@@ -1078,6 +1341,8 @@ def run_fault_leg() -> int:
 def main() -> int:
     if "--fault-leg" in sys.argv[1:]:
         return run_fault_leg()
+    if "--chaos-leg" in sys.argv[1:]:
+        return run_chaos_leg()
     if "--memory-leg" in sys.argv[1:]:
         return run_memory_leg()
     if "--perf-leg" in sys.argv[1:]:

@@ -1,0 +1,297 @@
+"""Rank-coherent recovery: the consensus control plane (resilience/coherence).
+
+Covers the agreement primitive itself (epochs, reductions, the
+propose/decide split, loopback ``force`` transport, single-controller
+no-op), its error vocabulary (``CoherentAbort`` routing through
+``retry.classify``), the coherent retry engine and degradation ladder
+(lockstep attempts, fleet-agreed terminal classes, the
+donation-exhausted abort — ISSUE 10 satellite), the ``rank=<i>``
+fault-injection payload, and the observability contract: every round
+emits a ``coherence`` event and accounts its bytes on the transfer
+ledger — never silently swallowed.
+
+The cross-process acceptance soak lives in
+``scripts/two_process_suite.py --chaos-leg``; these tests drive the same
+code paths single-process through the ``RAMBA_COHERENCE=force``
+loopback seam.
+"""
+
+import pytest
+
+import ramba_tpu as rt  # noqa: F401  (bootstraps the package like peers)
+from ramba_tpu import diagnostics
+from ramba_tpu.observe import events
+from ramba_tpu.resilience import coherence, degrade, faults, retry
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """Fresh coherence state per test, fast backoff, no leaked faults."""
+    monkeypatch.setenv("RAMBA_RETRY_BASE_S", "0.001")
+    faults.configure(None)
+    coherence.reset()
+    yield
+    coherence.reset()
+    faults.reset()
+
+
+@pytest.fixture
+def _force(monkeypatch):
+    """Engage the full coherence bookkeeping over the loopback
+    transport (single-process unit-test seam)."""
+    monkeypatch.setenv("RAMBA_COHERENCE", "force")
+    coherence.reset()
+    yield
+    coherence.reset()
+
+
+def _coherence_events():
+    return [e for e in events.snapshot_ring()
+            if e.get("type") == "coherence"]
+
+
+# -- the primitive -----------------------------------------------------------
+
+
+def test_single_process_on_mode_is_a_noop(monkeypatch):
+    monkeypatch.setenv("RAMBA_COHERENCE", "on")
+    before = len(_coherence_events())
+    assert not coherence.engaged()
+    assert coherence.agree("t:site", coherence.P_OOM) == coherence.P_OOM
+    assert coherence.decide("t:site", coherence.P_DROP) == coherence.P_DROP
+    coherence.propose("t:site", coherence.P_FATAL)
+    # no epoch, no event, no pending state: byte-identical behavior
+    assert coherence.last_epoch("t:site") == 0
+    assert coherence.report()["pending"] == {}
+    assert len(_coherence_events()) == before
+
+
+def test_off_mode_disarms_even_if_multiprocess(monkeypatch):
+    monkeypatch.setenv("RAMBA_COHERENCE", "off")
+    assert coherence.mode() == "off"
+    assert not coherence.engaged()
+    assert coherence.agree("t:site", 3) == 3
+    assert coherence.last_epoch("t:site") == 0
+
+
+def test_force_mode_rounds_epochs_events_and_ledger(_force):
+    c0 = diagnostics.counters()
+    d = coherence.agree("t:site", coherence.P_DROP)
+    assert d == coherence.P_DROP  # loopback: own proposal wins
+    coherence.agree("t:site", coherence.P_OK)
+    coherence.agree("t:other", 5, reduce="min")
+    assert coherence.last_epoch("t:site") == 2
+    assert coherence.last_epoch("t:other") == 1
+    evs = _coherence_events()[-3:]
+    assert [(e["site"], e["epoch"]) for e in evs] == [
+        ("t:site", 1), ("t:site", 2), ("t:other", 1)]
+    assert all("decision" in e and "proposal" in e for e in evs)
+    c1 = diagnostics.counters()
+    assert c1.get("coherence.rounds", 0) - c0.get("coherence.rounds", 0) == 3
+    # satellite: control-plane traffic lands on the transfer ledger
+    assert c1.get("distributed.coherence_count", 0) \
+        - c0.get("distributed.coherence_count", 0) == 3
+    assert c1.get("distributed.coherence_bytes", 0) \
+        > c0.get("distributed.coherence_bytes", 0)
+
+
+def test_propose_decide_merges_pending_severity_max(_force):
+    coherence.propose("t:site", coherence.P_RETRY)
+    coherence.propose("t:site", coherence.P_OOM)
+    coherence.propose("t:site", coherence.P_DROP)  # lower: must not regress
+    assert coherence.report()["pending"] == {"t:site": coherence.P_OOM}
+    d = coherence.decide("t:site", coherence.P_OK)
+    assert d == coherence.P_OOM
+    assert coherence.report()["pending"] == {}  # consumed by the round
+    # next decide is unaffected
+    assert coherence.decide("t:site", coherence.P_OK) == coherence.P_OK
+
+
+def test_agree_rejects_bad_reduce(_force):
+    with pytest.raises(ValueError):
+        coherence.agree("t:site", 0, reduce="sum")
+
+
+def test_report_shape(_force):
+    coherence.agree("t:a", 1)
+    r = coherence.report()
+    assert r["mode"] == "force" and r["engaged"]
+    assert r["epochs"] == {"t:a": 1}
+    assert r["overhead_s"] >= 0.0
+
+
+# -- CoherentAbort routing ---------------------------------------------------
+
+
+def test_coherent_abort_classification():
+    for code, cls in ((coherence.P_RETRY, "retryable"),
+                      (coherence.P_DROP, "degrade"),
+                      (coherence.P_OOM, "oom"),
+                      (coherence.P_FATAL, "fatal")):
+        e = coherence.CoherentAbort("flush:rung", code)
+        assert e.coherent_classification == cls
+        assert retry.classify(e) == cls
+        assert e.decision == code
+    assert "peer rank" in str(coherence.CoherentAbort("s", coherence.P_FATAL))
+    assert coherence.classification_code("oom") == coherence.P_OOM
+    assert coherence.decision_class(coherence.P_DROP) == "degrade"
+
+
+# -- coherent retry ----------------------------------------------------------
+
+
+def test_coherent_retry_success_and_recovery(_force):
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionError("Connection refused")
+        return "ok"
+
+    assert retry.call("t_site", flaky, coherent=True) == "ok"
+    assert calls["n"] == 3
+    # every attempt consumed one agreement round at retry:<site>
+    assert coherence.last_epoch("retry:t_site") == 3
+
+
+def test_coherent_retry_fatal_passthrough(_force):
+    with pytest.raises(TypeError):
+        retry.call("t_site", lambda: (_ for _ in ()).throw(TypeError("x")),
+                   coherent=True)
+    assert coherence.last_epoch("retry:t_site") == 1
+
+
+def test_coherent_retry_budget_exhausted(_force, monkeypatch):
+    monkeypatch.setenv("RAMBA_RETRY_ATTEMPTS", "2")
+
+    def always():
+        raise ConnectionError("Connection refused")
+
+    with pytest.raises(retry.RetryBudgetExhausted) as ei:
+        retry.call("t_site", always, coherent=True)
+    assert isinstance(ei.value.__cause__, ConnectionError)
+    assert coherence.last_epoch("retry:t_site") == 2
+
+
+def test_coherent_retry_peer_decision_drags_success(_force, monkeypatch):
+    """A locally-successful rank must drop when the fleet agrees to —
+    simulated by forcing the decision above the local P_OK proposal."""
+    monkeypatch.setattr(coherence, "decide",
+                        lambda site, local, **kw: coherence.P_DROP)
+    with pytest.raises(coherence.CoherentAbort) as ei:
+        retry.call("t_site", lambda: "fine", coherent=True)
+    assert ei.value.coherent_classification == "degrade"
+
+
+# -- coherent ladder ---------------------------------------------------------
+
+
+def test_coherent_ladder_drop_and_recover(_force):
+    seen = []
+
+    def r0():
+        seen.append("fused")
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+    def r1():
+        seen.append("split")
+        return 99
+
+    out, rung = degrade.run_ladder("t_flush", [("fused", r0), ("split", r1)])
+    assert (out, rung) == (99, "split")
+    # one rung round per rung outcome: oom at fused, ok at split
+    assert coherence.last_epoch("t_flush:rung") == 2
+
+
+def test_coherent_ladder_fatal_aborts_everywhere(_force):
+    def r0():
+        raise TypeError("programming error")
+
+    with pytest.raises(TypeError):
+        degrade.run_ladder("t_flush", [("fused", r0), ("split", lambda: 1)])
+    assert coherence.last_epoch("t_flush:rung") == 1
+
+
+def test_coherent_ladder_donation_exhausted_aborts(_force):
+    """ISSUE 10 satellite: donated inputs consumed + no lower rung =
+    every rank surfaces the same fatal-class terminal error (the local
+    degrade-class failure rides along as the abort's cause), with the
+    decision recorded as fatal on the agreement stream."""
+    def r0():
+        raise retry.RetryBudgetExhausted("t_flush: budget gone")
+
+    with pytest.raises(coherence.CoherentAbort) as ei:
+        degrade.run_ladder("t_flush",
+                           [("fused", r0), ("split", lambda: 1)],
+                           leaf_check=lambda: False)
+    assert ei.value.coherent_classification == "fatal"
+    assert "RetryBudgetExhausted" in str(ei.value)  # original not swallowed
+    evs = [e for e in _coherence_events() if e["site"] == "t_flush:rung"]
+    assert evs and evs[-1]["decision"] == coherence.P_FATAL
+
+
+def test_coherent_ladder_forced_drop_with_dead_leaves(_force, monkeypatch):
+    """A peer-forced drop on a rank whose own attempt succeeded (and
+    consumed its donated leaves) must coherently abort, not re-run the
+    lower rung against deleted buffers."""
+    decisions = iter([coherence.P_DROP, coherence.P_FATAL])
+    monkeypatch.setattr(coherence, "decide",
+                        lambda site, local, **kw: next(decisions))
+    alive = {"ok": True}
+
+    def r0():
+        alive["ok"] = False  # the successful attempt donated the leaves
+        return "done"
+
+    with pytest.raises(coherence.CoherentAbort) as ei:
+        degrade.run_ladder("t_flush",
+                           [("fused", r0), ("split", lambda: 1)],
+                           leaf_check=lambda: alive["ok"])
+    assert ei.value.coherent_classification == "fatal"
+
+
+def test_noncoherent_ladder_unchanged(monkeypatch):
+    """Coherence off: the ladder is the historical rank-local machine."""
+    monkeypatch.setenv("RAMBA_COHERENCE", "off")
+
+    def r0():
+        raise retry.RetryBudgetExhausted("x")
+
+    out, rung = degrade.run_ladder("t_flush",
+                                   [("fused", r0), ("split", lambda: 7)])
+    assert (out, rung) == (7, "split")
+    assert coherence.last_epoch("t_flush:rung") == 0
+
+
+# -- rank=<i> fault payload --------------------------------------------------
+
+
+def test_fault_rank_payload_parses_and_gates():
+    # single process: process_index 0 -> rank=0 fires, rank=1 disarms
+    faults.configure("a:always:rank=0,b:always:rank=1")
+    with pytest.raises(faults.InjectedFault):
+        faults.check("a")
+    for _ in range(3):
+        faults.check("b")  # never fires here
+    st = faults.stats()
+    assert st["b"] == {"calls": 3, "fired": 0}  # counters still advance
+
+
+def test_fault_rank_payload_composes_with_after():
+    faults.configure("c:after=2:rank=0")
+    fired = []
+    for _ in range(4):
+        try:
+            faults.check("c")
+            fired.append(False)
+        except faults.InjectedFault:
+            fired.append(True)
+    assert fired == [False, False, True, True]
+
+
+def test_fault_rank_payload_rejects_garbage():
+    with pytest.raises(ValueError):
+        faults._parse_one("a:once:rank=x")
+    with pytest.raises(ValueError):
+        faults._parse_one("a:once:rank=1:rank=2")
