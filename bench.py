@@ -525,6 +525,84 @@ def _bench_autotune(rt, platform):
     return out
 
 
+def _bench_reshard(rt, platform):
+    """Resharding section: staged device-collective layout-change
+    throughput (``reshard_gb_per_s``) and its measured ledger peak
+    (``reshard_peak_live_bytes`` — the src+dst+slab bound in practice),
+    plus the live mesh-reshape rung against the
+    drain→checkpoint→resume fallback on identical state
+    (``live_reshape_ms`` vs ``checkpoint_reshape_ms``)."""
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from ramba_tpu.parallel import mesh as _mesh_mod
+    from ramba_tpu.resilience import elastic as _elastic
+    from ramba_tpu.resilience import faults as _faults
+    from ramba_tpu.resilience import memory as _memory
+
+    out = {}
+    mesh = _mesh_mod.get_mesh()
+    ax = tuple(mesh.axis_names)
+    if mesh.devices.size < 2:
+        return out  # single device: no layout to change
+
+    rows = ((1 << 22) if platform == "cpu" else (1 << 24)) // 256
+    a = rt.asarray(
+        np.arange(rows * 256, dtype=np.float32).reshape(rows, 256))
+    a.asarray()
+    nbytes = rows * 256 * 4
+
+    def round_trip():
+        t0 = time.perf_counter()
+        rt.reshard(a, (None,) + (ax,))   # row -> column
+        rt.reshard(a, (ax,))             # column -> row
+        return time.perf_counter() - t0
+
+    round_trip()  # compile both directions outside the timed window
+    # window the ledger high-water mark so earlier sections' peak does
+    # not mask the reshard's own src+dst+slab footprint
+    led = _memory.ledger
+    with led._lock:
+        saved_peak = led.peak_live_bytes
+        led.peak_live_bytes = led.live_bytes + led.transient_bytes
+    wall = min(round_trip() for _ in range(3))
+    out["reshard_gb_per_s"] = round(2 * nbytes / wall / 1e9, 3)
+    out["reshard_peak_live_bytes"] = led.peak_live_bytes
+    with led._lock:
+        led.peak_live_bytes = max(saved_peak, led.peak_live_bytes)
+    del a
+
+    # live reshape rung vs checkpoint fallback, identical 2-device state
+    devs = jax.devices()
+    if len(devs) < 2 or jax.process_count() > 1:
+        return out
+    saved = mesh
+    try:
+        for mode, key in (("live", "live_reshape_ms"),
+                          ("checkpoint", "checkpoint_reshape_ms")):
+            _mesh_mod.set_mesh(
+                jax.sharding.Mesh(np.asarray(devs[:2]), ("d0",)))
+            x = rt.arange(1 << 16) * 1.0
+            x.asarray()
+            if mode == "checkpoint":
+                _faults.configure("reshard:plan:always")
+            try:
+                with tempfile.TemporaryDirectory() as td:
+                    res = _elastic.live_reshape(
+                        jax.sharding.Mesh(np.asarray(devs[:1]), ("d0",)),
+                        manager=td)
+            finally:
+                _faults.configure(None)
+            if res["mode"] == mode:
+                out[key] = round(res["wall_s"] * 1e3, 2)
+            del x
+    finally:
+        _mesh_mod.set_mesh(saved)
+    return out
+
+
 def _bench_dispatch_floor(rt):
     """Measured per-dispatch round-trip cost (flush + scalar fetch of a
     tiny computation): on a tunneled chip this floor dominates small
@@ -692,6 +770,11 @@ def main():
             out.update(_bench_autotune(rt, platform))
         except Exception:  # noqa: BLE001
             out["autotune_error"] = traceback.format_exc(limit=2)[-300:]
+
+        try:
+            out.update(_bench_reshard(rt, platform))
+        except Exception:  # noqa: BLE001
+            out["reshard_error"] = traceback.format_exc(limit=2)[-300:]
     except Exception:  # noqa: BLE001 - even import/backend failure emits JSON
         out["error"] = traceback.format_exc(limit=3)[-400:]
 

@@ -95,6 +95,7 @@ from ramba_tpu.parallel import distributed  # noqa: F401
 from ramba_tpu.parallel.constraints import (  # noqa: F401
     Constraint, add_constraint, get_constraints,
 )
+from ramba_tpu.parallel.reshard import reshard  # noqa: F401
 from ramba_tpu.utils.remote import get, jit, remote  # noqa: F401
 from ramba_tpu.utils import debug  # noqa: F401
 from ramba_tpu import serve  # noqa: F401

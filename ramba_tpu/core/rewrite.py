@@ -320,10 +320,73 @@ def _to_device(x: np.ndarray):
     return jnp.asarray(x)
 
 
+def rewrite_align_operand_layouts(node: Node):
+    """Fused elementwise operands whose device layouts disagree: wrap
+    the minority operands in ``shard_hint`` nodes targeting the most-
+    sharded operand's layout, so GSPMD lowers an explicit resharding
+    collective (all-to-all / collective-permute — the same lowering
+    ``parallel.reshard`` schedules) instead of falling back to
+    replicating one side.  Only full-shape concrete leaves participate
+    — broadcasting operands, lazy subtrees, and spilled buffers are
+    left for GSPMD's own propagation."""
+    if node.op != "map" or len(node.args) < 2 or node.aval is None:
+        return None
+    from jax.sharding import NamedSharding
+
+    from ramba_tpu.parallel import mesh as _mesh
+
+    try:
+        mesh = _mesh.get_mesh()
+    except Exception:
+        return None
+    if mesh.size <= 1:
+        return None
+    out_shape = tuple(node.aval.shape)
+
+    def _leaf_spec(a: Expr):
+        if not isinstance(a, Const):
+            return None
+        v = a.value
+        sh = getattr(v, "sharding", None)
+        if not isinstance(sh, NamedSharding) or sh.mesh != mesh:
+            return None
+        if tuple(getattr(v, "shape", ())) != out_shape:
+            return None
+        entries = tuple(sh.spec)
+        while entries and entries[-1] is None:
+            entries = entries[:-1]
+        return entries
+
+    shaped = [(i, s) for i, s in ((i, _leaf_spec(a))
+                                  for i, a in enumerate(node.args))
+              if s is not None]
+    if len(shaped) < 2 or len({s for _, s in shaped}) < 2:
+        return None
+    # Dominant layout = the one sharding the most dims (replication is
+    # what this rule exists to avoid); ties go to the earliest operand.
+    dom = None
+    for _, s in shaped:
+        if s and (dom is None
+                  or sum(1 for e in s if e) > sum(1 for e in dom if e)):
+            dom = s
+    if not dom:
+        return None
+    new_args = list(node.args)
+    changed = False
+    for i, s in shaped:
+        if s != dom:
+            new_args[i] = Node("shard_hint", (dom,), [node.args[i]])
+            changed = True
+    if not changed:
+        return None
+    return Node(node.op, node.static, new_args, aval=node.aval)
+
+
 RULES = [
     rewrite_arange_reshape,
     rewrite_stack_reduce_advindex,
     rewrite_concat_binop_getitem,
+    rewrite_align_operand_layouts,
 ]
 
 # Per-rule fire counts (observability; lets end-to-end tests assert that an

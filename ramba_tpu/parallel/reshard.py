@@ -1,0 +1,485 @@
+"""Device-side resharding with bounded peak memory and a coherence fence.
+
+Arrays used to be pinned to their bring-up sharding: the only layout
+change was a host round-trip (gather → re-``device_put``), and elastic
+mesh reshape had to go through drain→checkpoint→resume.  This module
+implements ``reshard(arr, new_spec)`` as a *schedule of device
+collectives* — the peak-memory-aware redistribution discipline of
+"Memory-efficient array redistribution through portable collective
+communication" (arXiv:2112.01075) applied to the GSPMD substrate:
+
+* **Plan** — :func:`plan_reshard` cuts the transfer into stages along
+  the array's longest axis so that no stage moves more than
+  ``max_stage_bytes`` (``RAMBA_RESHARD_STAGE_BYTES``, else the
+  governor's chunk target).  A single-stage plan is one jitted identity
+  with ``out_shardings`` — XLA lowers it to the all-to-all /
+  collective-permute pattern for the (src, dst) layout pair.  A staged
+  plan streams slabs: slice from the source layout, update into a
+  destination-layout accumulator (donated every stage, so there is
+  never more than one accumulator alive).  Peak live is bounded by
+  ``src + dst + one stage slab`` — never a full host gather.
+* **Fence** — under multi-controller execution the plan hash is agreed
+  through ``coherence.agree("reshard:plan", ...)`` (rank 0 broadcasts,
+  every rank verifies) before any collective runs, so the fleet
+  executes the identical stage list or nobody moves: a rank with a
+  divergent plan aborts the reshard *before* the first all-to-all can
+  mispair.
+* **Admission** — every stage asks the HBM governor for headroom first
+  (``memory.reserve_headroom``): resharding a near-budget array spills
+  LRU victims instead of OOMing mid-transfer.  Stage buffers ride in
+  the ledger's transient accounting so ``peak_live_bytes`` stays
+  honest.
+* **Rollback** — the source buffer is never donated and never mutated;
+  a stage failure (real or ``RAMBA_FAULTS`` ``reshard:stage``) drops
+  the partial destination, emits a ``reshard``/``rollback`` event, and
+  re-raises as :class:`ReshardError` — the caller still holds the
+  intact source, so a torn array is impossible by construction.
+
+Fault sites: ``reshard:plan`` (after the fence, before stage 0) and
+``reshard:stage`` (top of every stage) — both compose with ``rank=``,
+``after=``, and ``hang:ms=`` payloads, which is how the chaos leg kills
+a reshard mid-schedule on one rank only.
+
+Everything observable lands on the observe stream as ``reshard``
+events (action plan/stage/done/rollback with epoch, stage index and
+bytes), the ``reshard.*`` counters, and per-transfer bytes on the
+``distributed`` ledger (``note_transfer("reshard", ...)``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ramba_tpu import common as _common
+from ramba_tpu.observe import events as _events
+from ramba_tpu.observe import registry as _registry
+from ramba_tpu.parallel import distributed as _distributed
+from ramba_tpu.parallel import mesh as _mesh
+from ramba_tpu.resilience import coherence as _coherence
+from ramba_tpu.resilience import faults as _faults
+from ramba_tpu.resilience import memory as _memory
+
+
+class ReshardError(RuntimeError):
+    """A reshard schedule failed (stage fault, plan divergence).  The
+    source array is guaranteed intact — callers may retry, fall back to
+    the checkpoint path, or surface the error."""
+
+
+class PlanMismatch(ReshardError):
+    """The coherence fence disagreed with this rank's locally-computed
+    plan hash: the ranks would have executed different stage lists."""
+
+
+#: Monotonic reshard epoch — one per reshard operation, advanced in
+#: lockstep under SPMD (every rank plans the same reshard sequence).
+_epoch_counter = itertools.count(1)
+_epoch_lock = threading.Lock()
+
+
+def _next_epoch() -> int:
+    with _epoch_lock:
+        return next(_epoch_counter)
+
+
+# ---------------------------------------------------------------------------
+# plan
+# ---------------------------------------------------------------------------
+
+
+def _norm_spec(spec) -> tuple:
+    """Canonical PartitionSpec entries with trailing Nones stripped —
+    ``P('x')`` and ``P('x', None)`` describe the same layout."""
+    if spec is None:
+        return ()
+    entries = tuple(spec)
+    while entries and entries[-1] is None:
+        entries = entries[:-1]
+    return entries
+
+
+def _spec_of(value) -> tuple:
+    """Normalized spec of a concrete array; () (replicated/single-device)
+    when the value carries no NamedSharding on the current mesh."""
+    from jax.sharding import NamedSharding
+
+    sh = getattr(value, "sharding", None)
+    if isinstance(sh, NamedSharding):
+        return _norm_spec(sh.spec)
+    return ()
+
+
+def default_stage_bytes() -> int:
+    """Per-stage transfer budget: ``RAMBA_RESHARD_STAGE_BYTES`` when
+    set, else the governor's (coherently min-agreed) chunk target."""
+    raw = os.environ.get("RAMBA_RESHARD_STAGE_BYTES")
+    if raw:
+        try:
+            return max(1 << 16, _common.parse_bytes(raw))
+        except ValueError:
+            pass
+    return _memory.chunk_target_bytes()
+
+
+class Stage:
+    """One slab of the transfer: global rows ``[lo, hi)`` along
+    ``plan.axis``, moved by one collective step."""
+
+    __slots__ = ("index", "lo", "hi", "nbytes")
+
+    def __init__(self, index: int, lo: int, hi: int, nbytes: int):
+        self.index = index
+        self.lo = lo
+        self.hi = hi
+        self.nbytes = nbytes
+
+    def __repr__(self):
+        return f"Stage({self.index}, [{self.lo}:{self.hi}), {self.nbytes}B)"
+
+
+class ReshardPlan:
+    """An agreed, bounded-peak-memory schedule for one layout change."""
+
+    __slots__ = ("shape", "dtype", "src_spec", "dst_spec", "axis",
+                 "stages", "total_bytes", "max_stage_bytes")
+
+    def __init__(self, shape, dtype, src_spec, dst_spec, axis, stages,
+                 total_bytes, max_stage_bytes):
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = np.dtype(dtype)
+        self.src_spec = tuple(src_spec)
+        self.dst_spec = tuple(dst_spec)
+        self.axis = axis            # None for a single-stage plan
+        self.stages = list(stages)
+        self.total_bytes = int(total_bytes)
+        self.max_stage_bytes = int(max_stage_bytes)
+
+    @property
+    def peak_bound_bytes(self) -> int:
+        """The schedule's peak-live guarantee: source + destination +
+        the largest in-flight stage slab."""
+        stage_max = max((s.nbytes for s in self.stages), default=0)
+        if len(self.stages) <= 1:
+            # one collective: src + dst are the only buffers alive
+            return 2 * self.total_bytes
+        return 2 * self.total_bytes + stage_max
+
+    def describe(self) -> str:
+        """Canonical plan text — what the coherence fence hashes.  Pure
+        function of (shape, dtype, layouts, stage list), so SPMD ranks
+        computing the same reshard produce byte-identical text."""
+        rows = [
+            f"shape={self.shape} dtype={self.dtype}",
+            f"src={self.src_spec!r} dst={self.dst_spec!r} axis={self.axis}",
+        ]
+        rows += [f"stage {s.index}: [{s.lo}:{s.hi}) {s.nbytes}B"
+                 for s in self.stages]
+        return "\n".join(rows)
+
+    def hash31(self) -> int:
+        """The plan digest folded to 31 bits — the coherence transport
+        is int32, so the fence broadcasts this and each rank compares."""
+        h = hashlib.sha1(self.describe().encode()).digest()
+        return int.from_bytes(h[:4], "big") & 0x7FFFFFFF
+
+
+def plan_reshard(shape, dtype, src_spec, dst_spec, *,
+                 max_stage_bytes: Optional[int] = None) -> ReshardPlan:
+    """Build the stage schedule for ``shape``/``dtype`` moving from
+    ``src_spec`` to ``dst_spec``.  Deterministic: same inputs → same
+    plan on every rank (the fence then proves it)."""
+    shape = tuple(int(s) for s in shape)
+    dtype = np.dtype(dtype)
+    src = _norm_spec(src_spec)
+    dst = _norm_spec(dst_spec)
+    total = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize \
+        if shape else dtype.itemsize
+    if max_stage_bytes is None:
+        max_stage_bytes = default_stage_bytes()
+    max_stage_bytes = max(1, int(max_stage_bytes))
+    if total <= max_stage_bytes or not shape:
+        return ReshardPlan(shape, dtype, src, dst, None,
+                           [Stage(0, 0, shape[0] if shape else 1, total)],
+                           total, max_stage_bytes)
+    # Slab along the longest axis: most stage-count headroom, and the
+    # slab boundary math stays a pure function of the shape.
+    axis = int(np.argmax(shape))
+    n = shape[axis]
+    row_bytes = max(1, total // max(1, n))
+    rows_per_stage = max(1, max_stage_bytes // row_bytes)
+    stages = []
+    lo = 0
+    i = 0
+    while lo < n:
+        hi = min(n, lo + rows_per_stage)
+        stages.append(Stage(i, lo, hi, (hi - lo) * row_bytes))
+        lo = hi
+        i += 1
+    return ReshardPlan(shape, dtype, src, dst, axis, stages, total,
+                       max_stage_bytes)
+
+
+# ---------------------------------------------------------------------------
+# execution
+# ---------------------------------------------------------------------------
+
+# jit caches keyed by program structure — a reshard sequence repeated
+# over many arrays of one shape compiles its collectives once.
+_identity_cache: dict = {}
+_zeros_cache: dict = {}
+_stage_cache: dict = {}
+
+
+def _dst_sharding(plan: ReshardPlan, mesh=None):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if mesh is None:
+        mesh = _mesh.get_mesh()
+    return NamedSharding(mesh, PartitionSpec(*plan.dst_spec))
+
+
+def _identity_fn(dst_sharding):
+    import jax
+
+    fn = _identity_cache.get(dst_sharding)
+    if fn is None:
+        fn = jax.jit(lambda x: x, out_shardings=dst_sharding)
+        _identity_cache[dst_sharding] = fn
+    return fn
+
+
+def _zeros_fn(shape, dtype, dst_sharding):
+    import jax
+    import jax.numpy as jnp
+
+    key = (shape, str(dtype), dst_sharding)
+    fn = _zeros_cache.get(key)
+    if fn is None:
+        fn = jax.jit(lambda: jnp.zeros(shape, dtype),
+                     out_shardings=dst_sharding)
+        _zeros_cache[key] = fn
+    return fn
+
+
+def _stage_fn(ndim, axis, size, dst_sharding):
+    """Jitted slab move: slice ``size`` rows at traced offset ``lo``
+    from the source layout, update them into the (donated) destination
+    accumulator.  At most two compiles per plan — the body size and the
+    remainder size."""
+    import jax
+    import jax.numpy as jnp
+
+    key = (ndim, axis, size, dst_sharding)
+    fn = _stage_cache.get(key)
+    if fn is None:
+        def body(dst, src, lo):
+            slab = jax.lax.dynamic_slice_in_dim(src, lo, size, axis)
+            starts = [jnp.zeros((), jnp.int32)] * ndim
+            starts[axis] = lo
+            return jax.lax.dynamic_update_slice(dst, slab, tuple(starts))
+
+        fn = jax.jit(body, out_shardings=dst_sharding, donate_argnums=0)
+        _stage_cache[key] = fn
+    return fn
+
+
+def agree_plan(plan: ReshardPlan, epoch: int) -> int:
+    """The epoch fence: rank 0 broadcasts its plan hash, every rank
+    verifies against its own.  Returns the coherence epoch of the round
+    (0 when not engaged).  Raises :class:`PlanMismatch` on divergence —
+    before any collective has run, so no rank is left mid-schedule."""
+    if not _coherence.engaged():
+        return 0
+    mine = plan.hash31()
+    agreed = _coherence.agree("reshard:plan", mine, reduce="bcast")
+    if agreed != mine:
+        _registry.inc("reshard.plan_mismatches")
+        raise PlanMismatch(
+            f"reshard epoch {epoch}: plan hash {mine:#x} disagrees with "
+            f"fleet decision {agreed:#x}")
+    return _coherence.last_epoch("reshard:plan")
+
+
+def _gate(site: str, ep: int, **ctx) -> None:
+    """Fault check + fleet agreement before a collective step.
+
+    Under coherent multi-controller execution a fault injected on ONE
+    rank must abort the stage on EVERY rank *before* its collective
+    launches — otherwise the faulted rank leaves the schedule while its
+    peers block inside an all-to-all that can never complete.  The
+    injected error is caught locally, severity-agreed (max), and then
+    raised fleet-wide; a clean gate costs one agreement round.  Not
+    engaged: a plain ``faults.check``."""
+    err: Optional[Exception] = None
+    coh = _coherence.engaged()
+    try:
+        _faults.check(site, epoch=ep, **ctx)
+    except Exception as e:
+        if not coh:
+            raise
+        err = e
+    if not coh:
+        return
+    my = _coherence.P_OK if err is None else _coherence.P_DROP
+    decision = _coherence.agree(f"{site}:gate", my, reduce="max")
+    if decision != _coherence.P_OK:
+        if err is not None:
+            raise err
+        raise _coherence.CoherentAbort(f"{site}:gate", decision)
+
+
+def execute_plan(value, plan: ReshardPlan, *, epoch: Optional[int] = None,
+                 mesh=None):
+    """Run an (already fenced) plan over a concrete ``jax.Array``.
+    Returns the destination-layout array; the source is left intact.
+    ``mesh`` overrides the destination mesh (live mesh reshape moves
+    arrays onto a mesh that is not yet the global one).  Any failure
+    rolls back (drops the partial destination) and re-raises as
+    :class:`ReshardError`."""
+    ep = epoch if epoch is not None else _next_epoch()
+    dst_sharding = _dst_sharding(plan, mesh)
+    _registry.inc("reshard.plans")
+    _events.emit({
+        "type": "reshard", "action": "plan", "epoch": ep,
+        "stages": len(plan.stages), "bytes": plan.total_bytes,
+        "peak_bound_bytes": plan.peak_bound_bytes,
+        "src": repr(plan.src_spec), "dst": repr(plan.dst_spec),
+    })
+    # Destination on a different device set (live mesh reshape shrinking
+    # or growing the fleet): jit cannot re-home operands, so the whole
+    # array moves through one governed device_put instead of staged
+    # collectives.  Peak-live is still src + dst.
+    src_devices = getattr(getattr(value, "sharding", None), "device_set",
+                          None)
+    cross_mesh = (src_devices is not None
+                  and src_devices != dst_sharding.device_set)
+    try:
+        _gate("reshard:plan", ep)
+        if cross_mesh:
+            _gate("reshard:stage", ep, stage=0)
+            out = _memory.governed_device_put(value, dst_sharding,
+                                              site="reshard:stage")
+            out.block_until_ready()
+            _registry.inc("reshard.stages")
+            _registry.inc("reshard.cross_mesh")
+            _distributed.note_transfer("reshard", plan.total_bytes)
+            _events.emit({
+                "type": "reshard", "action": "stage", "epoch": ep,
+                "stage": 0, "bytes": plan.total_bytes,
+                "cross_mesh": True,
+            })
+        elif len(plan.stages) <= 1:
+            _gate("reshard:stage", ep, stage=0)
+            _memory.reserve_headroom(plan.total_bytes, site="reshard:stage")
+            _memory.ledger._begin_transient(plan.total_bytes)
+            try:
+                out = _identity_fn(dst_sharding)(value)
+                out.block_until_ready()
+            finally:
+                _memory.ledger._end_transient(plan.total_bytes)
+            _registry.inc("reshard.stages")
+            _distributed.note_transfer("reshard", plan.total_bytes)
+            _events.emit({
+                "type": "reshard", "action": "stage", "epoch": ep,
+                "stage": 0, "bytes": plan.total_bytes,
+            })
+        else:
+            import jax.numpy as jnp
+
+            _memory.reserve_headroom(plan.total_bytes, site="reshard:dst")
+            dst = _zeros_fn(plan.shape, plan.dtype, dst_sharding)()
+            _memory.ledger._begin_transient(plan.total_bytes)
+            try:
+                for st in plan.stages:
+                    _gate("reshard:stage", ep, stage=st.index)
+                    _memory.reserve_headroom(st.nbytes,
+                                             site="reshard:stage")
+                    _memory.ledger._begin_transient(st.nbytes)
+                    try:
+                        fn = _stage_fn(len(plan.shape), plan.axis,
+                                       st.hi - st.lo, dst_sharding)
+                        dst = fn(dst, value, jnp.int32(st.lo))
+                        dst.block_until_ready()
+                    finally:
+                        _memory.ledger._end_transient(st.nbytes)
+                    _registry.inc("reshard.stages")
+                    _distributed.note_transfer("reshard", st.nbytes)
+                    _events.emit({
+                        "type": "reshard", "action": "stage", "epoch": ep,
+                        "stage": st.index, "bytes": st.nbytes,
+                    })
+                out = dst
+            finally:
+                _memory.ledger._end_transient(plan.total_bytes)
+    except ReshardError:
+        raise
+    except Exception as e:
+        # The partial destination (if any) dies with this frame; the
+        # source was never donated — rolling back IS dropping our work.
+        _registry.inc("reshard.rollbacks")
+        _events.emit({
+            "type": "reshard", "action": "rollback", "epoch": ep,
+            "error": f"{type(e).__name__}: {e}"[:200],
+        })
+        raise ReshardError(
+            f"reshard epoch {ep} failed; source sharding intact") from e
+    _registry.inc("reshard.completed")
+    _events.emit({
+        "type": "reshard", "action": "done", "epoch": ep,
+        "bytes": plan.total_bytes, "stages": len(plan.stages),
+    })
+    return out
+
+
+def reshard_value(value, new_spec, *,
+                  max_stage_bytes: Optional[int] = None, mesh=None):
+    """Reshard a concrete ``jax.Array`` to ``new_spec`` on the current
+    mesh (or an explicit target ``mesh``): plan → fence → staged
+    collectives.  Returns the new array (or ``value`` itself when the
+    layout already matches)."""
+    from jax.sharding import NamedSharding
+
+    dst = _norm_spec(new_spec)
+    src = _spec_of(value)
+    sh = getattr(value, "sharding", None)
+    target_mesh = mesh if mesh is not None else _mesh.get_mesh()
+    if (src == dst and isinstance(sh, NamedSharding)
+            and sh.mesh == target_mesh):
+        return value
+    plan = plan_reshard(value.shape, value.dtype, src, dst,
+                        max_stage_bytes=max_stage_bytes)
+    ep = _next_epoch()
+    agree_plan(plan, ep)
+    return execute_plan(value, plan, epoch=ep, mesh=mesh)
+
+
+def reshard(arr, new_spec, *, max_stage_bytes: Optional[int] = None):
+    """Reshard an array to ``new_spec`` in place and return it.
+
+    ``arr`` may be a ``ramba_tpu.ndarray`` (lazy work is flushed, a
+    spilled backing buffer is restored, and the array's leaf is swapped
+    to the new layout — views through it keep working) or a raw
+    ``jax.Array`` (functional: the resharded array is returned).  On
+    schedule failure the array is untouched — same value, same layout.
+    """
+    from ramba_tpu.core.ndarray import ndarray as _ndarray
+
+    if not isinstance(arr, _ndarray):
+        return reshard_value(arr, new_spec,
+                             max_stage_bytes=max_stage_bytes)
+    if arr._base is not None:
+        raise ValueError("reshard: views cannot be resharded; "
+                         "reshard the base array")
+    value = arr._value()  # flush + restore-from-spill
+    out = reshard_value(value, new_spec, max_stage_bytes=max_stage_bytes)
+    if out is not value:
+        from ramba_tpu.core.expr import Const
+
+        arr._set_expr(Const(out))
+    return arr

@@ -764,6 +764,143 @@ def resume(path, *, step: Optional[int] = None, mesh=None) -> Resumed:
     return Resumed(int(step), state, man)
 
 
+# -- live mesh reshape -------------------------------------------------------
+
+def _reshape_census():
+    """Snapshot every ledger-tracked array as ``(entry, const, value)``
+    triples (spilled entries included — their Const still owns the host
+    wrapper).  Entries whose owners all died are skipped."""
+    triples = []
+    led = _memory.ledger
+    with led._lock:
+        for e in list(led.entries.values()):
+            consts = led._live_consts(e)
+            if not consts:
+                continue
+            triples.append((e, consts[0], consts[0].value))
+    return triples
+
+
+def _census_hash31(triples) -> int:
+    import hashlib
+
+    lines = sorted(
+        f"{tuple(v.shape)}:{np.dtype(v.dtype)}" for _, _, v in triples)
+    h = hashlib.sha1("\n".join(lines).encode()).digest()
+    return int.from_bytes(h[:4], "big") & 0x7FFFFFFF
+
+
+def live_reshape(new_mesh, *, manager=None, step: int = 0,
+                 max_stage_bytes: Optional[int] = None) -> dict:
+    """Reshape the job onto ``new_mesh`` without leaving the process:
+    fence → quiesce → reshard every live array in place → commit.
+
+    The ladder, top rung first:
+
+    1. **Live** — a coherence-agreed epoch fence (census hash broadcast
+       + go/no-go vote) ensures every rank sees the same array set, the
+       serve pipeline and all flush streams quiesce under the drain
+       deadline, spilled arrays are restored, and each array is
+       resharded onto ``new_mesh``'s default spec via the staged
+       collective schedule in ``parallel.reshard`` (governor-admitted,
+       bounded peak-live).  Nothing commits until every array has a new
+       buffer; then all ledger entries swap atomically and
+       ``set_mesh(new_mesh)`` bumps the mesh epoch (invalidating
+       compiled programs).
+    2. **Fallback** — only when the reshard schedule itself fails (or
+       the fleet votes no-go): ``drain_to_checkpoint`` + :func:`resume`
+       through ``manager`` (a temp directory when not given), the path
+       that used to be the only one.
+
+    Either way the source arrays stay intact until their replacement is
+    ready — a failed reshape never tears an array.  Returns a dict with
+    ``mode`` (``"live"`` / ``"checkpoint"``), array count, bytes moved,
+    and wall seconds."""
+    from ramba_tpu.parallel import mesh as _mesh_mod
+    from ramba_tpu.parallel import reshard as _reshard
+
+    t0 = time.perf_counter()
+    old_mesh = _mesh_mod.get_mesh()
+    _events.emit({
+        "type": "lifecycle", "phase": "reshape_begin",
+        "from_mesh": dict(old_mesh.shape), "to_mesh": dict(new_mesh.shape),
+    })
+    with_deadline("drain", quiesce, timeout_s=_drain_deadline())
+    triples = _reshape_census()
+    go = _coherence.P_OK
+    if _coherence.engaged():
+        mine = _census_hash31(triples)
+        agreed = _coherence.agree("elastic:reshape", mine, reduce="bcast")
+        if agreed != mine:
+            go = _coherence.P_DROP
+        decision = _coherence.agree("elastic:reshape:go", go, reduce="max")
+    else:
+        decision = go
+    err: Optional[str] = None
+    pairs = []
+    total = 0
+    if decision == _coherence.P_OK:
+        try:
+            for e, const, value in triples:
+                if e.spilled:
+                    value = _memory.ledger.restore(const)
+                spec = _mesh_mod.default_spec(value.shape, new_mesh)
+                out = _reshard.reshard_value(
+                    value, spec, mesh=new_mesh,
+                    max_stage_bytes=max_stage_bytes)
+                pairs.append((value, out))
+                total += int(e.nbytes)
+        except (_reshard.ReshardError, _coherence.CoherentAbort) as exc:
+            err = f"{type(exc).__name__}: {exc}"[:200]
+            pairs = []
+    else:
+        err = "fleet voted no-go (census hash mismatch on a peer rank)"
+    if err is None:
+        for old, new in pairs:
+            _memory.ledger.swap_value(old, new)
+        _mesh_mod.set_mesh(new_mesh)
+        _registry.inc("elastic.live_reshapes")
+        wall = round(time.perf_counter() - t0, 4)
+        _events.emit({
+            "type": "lifecycle", "phase": "reshape_live_complete",
+            "arrays": len(pairs), "bytes": int(total), "wall_s": wall,
+        })
+        return {"mode": "live", "arrays": len(pairs),
+                "bytes": int(total), "wall_s": wall}
+
+    # Fallback rung: the sources are untouched (no swap happened), so
+    # the old checkpoint path still sees a consistent pre-reshape world.
+    import tempfile
+
+    _registry.inc("elastic.reshape_fallbacks")
+    _events.emit({
+        "type": "lifecycle", "phase": "reshape_fallback", "error": err,
+    })
+    root = manager if manager is not None \
+        else tempfile.mkdtemp(prefix="ramba-reshape-")
+    tree = {str(i): v for i, (_, _, v) in enumerate(triples)}
+    mgr = root if isinstance(root, CheckpointManager) \
+        else CheckpointManager(root)
+    drain_to_checkpoint(mgr, step, tree)
+    res = resume(mgr, step=step, mesh=new_mesh)
+    from ramba_tpu.core.ndarray import ndarray as _ndarray
+
+    for i, (_, _, old) in enumerate(triples):
+        leaf = res.state[str(i)]
+        if isinstance(leaf, _ndarray):  # checkpoint.restore re-wraps
+            leaf = leaf._value()
+        _memory.ledger.swap_value(old, leaf)
+    _mesh_mod.set_mesh(new_mesh)
+    wall = round(time.perf_counter() - t0, 4)
+    _events.emit({
+        "type": "lifecycle", "phase": "reshape_checkpoint_complete",
+        "arrays": len(triples), "wall_s": wall,
+    })
+    return {"mode": "checkpoint", "arrays": len(triples),
+            "bytes": int(sum(e.nbytes for e, _, _ in triples)),
+            "wall_s": wall}
+
+
 def report() -> dict:
     """Diagnostics rollup for ``ramba_tpu.diagnostics.report()``."""
     return {
@@ -778,4 +915,6 @@ def report() -> dict:
         "checkpoints": int(_registry.get("elastic.checkpoints")),
         "resumes": int(_registry.get("elastic.resumes")),
         "drains": int(_registry.get("elastic.drains")),
+        "live_reshapes": int(_registry.get("elastic.live_reshapes")),
+        "reshape_fallbacks": int(_registry.get("elastic.reshape_fallbacks")),
     }

@@ -51,9 +51,21 @@ Sites are free-form strings; the ones wired into the codebase are
 ``checkpoint_io``, ``fileio``, ``init_connect``, ``dispatch`` (checked
 at the top of every degradation-ladder rung attempt — the seam the
 elastic watchdog wraps), ``heartbeat`` (checked before each liveness
-beacon, so a seeded hang delays a beat), and ``donate_census``
+beacon, so a seeded hang delays a beat), ``donate_census``
 (which does not fail the flush: it corrupts the buffer-donation mask so
-the RAMBA_VERIFY donation-hazard rule has a real violation to catch).  The ``oom`` site (or a
+the RAMBA_VERIFY donation-hazard rule has a real violation to catch),
+``reshard:plan`` (checked after the coherence fence agrees a reshard
+schedule, before any stage runs), and ``reshard:stage`` (checked at
+the top of every reshard stage — ``reshard:stage:2`` kills a reshard
+mid-schedule, ``reshard:stage:hang:ms=500:after=1`` stalls stage 2).
+
+Site names may themselves contain colons (``reshard:plan``,
+``reshard:stage``): the site/mode boundary in a spec is the FIRST
+``:``-separated field that parses as a mode token (``once``/``always``/
+``delay``/``hang``/``after=N``/a number).  No single-segment legacy
+site is ever a mode token, so historical specs parse identically, and
+the colon-site specs compose with every payload —
+``reshard:stage:always:rank=1`` fires every stage check on rank 1 only.  The ``oom`` site (or a
 trailing ``:oom`` kind) raises :class:`InjectedResourceExhausted`, whose
 message carries the ``RESOURCE_EXHAUSTED`` marker the retry classifier
 keys on; a trailing ``:fatal`` kind raises a non-retryable fault.  An
@@ -147,18 +159,47 @@ _specs: Dict[str, _Spec] = {}
 _seed = 0
 
 
+def _is_mode_token(tok: str) -> bool:
+    """True iff ``tok`` is a valid mode field — the site/mode boundary
+    marker for colon-containing site names (``reshard:stage``)."""
+    tok = tok.strip().lower()
+    if tok in ("once", "always", "delay", "hang"):
+        return True
+    if tok.startswith("after="):
+        try:
+            int(tok[len("after="):])
+        except ValueError:
+            return False
+        return True
+    try:
+        float(tok)  # covers both integer counts and probabilities
+    except ValueError:
+        return False
+    return True
+
+
 def _parse_one(chunk: str) -> _Spec:
     parts = chunk.strip().split(":")
     if len(parts) < 2 or not parts[0]:
         raise ValueError(f"bad RAMBA_FAULTS spec {chunk!r}: want site:mode")
-    site = parts[0].strip()
-    mode = parts[1].strip()
+    # The site may itself contain colons ("reshard:plan"): the mode is
+    # the first field that parses as a mode token, everything before it
+    # joins back into the site.  Legacy single-segment sites never look
+    # like mode tokens, so old specs parse byte-identically.
+    mi = next((i for i in range(1, len(parts))
+               if _is_mode_token(parts[i])), None)
+    if mi is None:
+        raise ValueError(
+            f"bad RAMBA_FAULTS spec {chunk!r}: no mode field "
+            f"(once/always/delay/hang/after=N/<count>/<prob>)")
+    site = ":".join(p.strip() for p in parts[:mi])
+    mode = parts[mi].strip()
     kind = ""
     nbytes: Optional[int] = None
     delay_ms: Optional[float] = None
     after_n: Optional[int] = None
     rank_i: Optional[int] = None
-    for extra in parts[2:]:
+    for extra in parts[mi + 1:]:
         extra = extra.strip().lower()
         if extra.startswith("rank="):
             if rank_i is not None:

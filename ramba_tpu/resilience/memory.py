@@ -212,6 +212,11 @@ class Ledger:
         self.peak_live_bytes = 0
         self.evictions = 0
         self.restores = 0
+        # bytes placed through governed_device_put that are still alive
+        # but not (yet) census-owned — padded stencil operands, reshard
+        # stage buffers.  Counted into peak_live_bytes so transient
+        # device traffic cannot hide from the bookkeeping.
+        self.transient_bytes = 0
         # tenant -> resident (non-spilled) bytes, for serving quotas.
         # Keys appear on first materialization under a serve.Session.
         self.tenant_live: dict = {}
@@ -394,6 +399,58 @@ class Ledger:
         })
         return arr
 
+    def swap_value(self, old, new) -> bool:
+        """Replace a resident buffer with ``new`` in place: every live
+        Const owning ``old`` is repointed, the fuser census is rekeyed,
+        and the ledger entry follows the buffer (nbytes delta included).
+        The sanctioned commit path for reshard/live-reshape, mirroring
+        ``_spill_entry``'s rekey discipline.  Returns False when ``old``
+        is not tracked (caller keeps both values alive; nothing swapped).
+        """
+        if old is new:
+            return True
+        with self._lock:
+            e = self.entries.get(id(old))
+            if e is None:
+                return False
+            consts = self._live_consts(e)
+            for c in consts:
+                c.value = new
+            from ramba_tpu.core import fuser as _fuser
+
+            _fuser.owner_rekey(old, new)
+            del self.entries[e.key]
+            e.key = id(new)
+            e.consts = [weakref.ref(c) for c in consts]
+            e.seq = next(self._clock)
+            self.entries[e.key] = e
+            new_nbytes = _nbytes(new)
+            if not e.spilled:
+                self.live_bytes += new_nbytes - e.nbytes
+                self._tenant_add(e, -1)
+                e.nbytes = new_nbytes
+                self._tenant_add(e, +1)
+                if self.live_bytes > self.peak_live_bytes:
+                    self.peak_live_bytes = self.live_bytes
+            else:
+                self.spilled_bytes += new_nbytes - e.nbytes
+                e.nbytes = new_nbytes
+        _update_gauges(self)
+        return True
+
+    # -- transient (non-census) placements ---------------------------------
+
+    def _begin_transient(self, nbytes: int) -> None:
+        with self._lock:
+            self.transient_bytes += nbytes
+            peak = self.live_bytes + self.transient_bytes
+            if peak > self.peak_live_bytes:
+                self.peak_live_bytes = peak
+
+    def _end_transient(self, nbytes: int) -> None:
+        with self._lock:
+            self.transient_bytes = max(0, self.transient_bytes - nbytes)
+
     def evict_until(self, need: int, tenant: Optional[str] = None) -> int:
         """Spill LRU-coldest candidates until ``need`` bytes are freed (or
         candidates run out).  Returns bytes actually freed.  ``tenant``
@@ -450,6 +507,7 @@ class Ledger:
                 "live_bytes": self.live_bytes,
                 "spilled_bytes": self.spilled_bytes,
                 "pinned_bytes": pinned,
+                "transient_bytes": self.transient_bytes,
                 "peak_live_bytes": self.peak_live_bytes,
                 "evictions": self.evictions,
                 "restores": self.restores,
@@ -722,6 +780,73 @@ def evict_for_oom(exc: BaseException) -> int:
         "freed_bytes": freed, "live_bytes": ledger.live_bytes,
     })
     return freed
+
+
+# ---------------------------------------------------------------------------
+# governor-accounted placement
+# ---------------------------------------------------------------------------
+
+
+def reserve_headroom(nbytes: int, *, site: str = "transient") -> int:
+    """Make room for an ``nbytes`` placement: when a budget is known and
+    ``live + transient + nbytes`` crosses the watermark, spill LRU
+    victims until it fits (or candidates run out).  Returns bytes freed;
+    0 when no budget is armed or the placement already fits.  This is
+    the admission check for non-census device traffic — reshard stage
+    buffers, padded operand copies."""
+    budget = budget_bytes()
+    if budget is None or nbytes <= 0:
+        return 0
+    wm = watermark_bytes(budget) or budget
+    with ledger._lock:
+        projected = ledger.live_bytes + ledger.transient_bytes + int(nbytes)
+    if projected <= wm:
+        return 0
+    _events.emit({
+        "type": "memory", "action": "watermark", "site": site,
+        "over_bytes": projected - wm, "watermark_bytes": wm,
+    })
+    return ledger.evict_until(projected - wm)
+
+
+def governed_device_put(value, sharding=None, *, site: str = "device_put"):
+    """``jax.device_put`` with admission through the HBM governor.
+
+    Device placements outside the fuser's owner census — padded stencil
+    operands in ``skeletons.spmd``, reshard stage buffers — used to be
+    invisible to the ledger: no admission check, no peak-live
+    accounting.  This is their sanctioned path:
+
+    1. admission: when a budget is known and ``live + transient +
+       nbytes`` crosses the watermark, LRU victims are spilled first
+       (``evict_until``) — a near-budget placement spills instead of
+       OOMing;
+    2. placement: plain ``jax.device_put``;
+    3. accounting: the buffer's bytes ride in
+       ``ledger.transient_bytes`` (and therefore ``peak_live_bytes``)
+       until the returned array is garbage-collected, via a weakref
+       finalizer — no caller-side release protocol.
+
+    Zero-cost when the value has no measurable size; budgetless
+    backends skip admission but still account the transient peak.
+    """
+    import jax
+
+    nbytes = _nbytes(value)
+    reserve_headroom(nbytes, site=site)
+    out = jax.device_put(value, sharding) if sharding is not None \
+        else jax.device_put(value)
+    placed = _nbytes(out) or nbytes
+    if placed > 0:
+        ledger._begin_transient(placed)
+        weakref.finalize(out, ledger._end_transient, placed)
+        _registry.inc("memory.governed_puts")
+        _events.emit({
+            "type": "memory", "action": "governed_put", "site": site,
+            "bytes": placed, "live_bytes": ledger.live_bytes,
+            "transient_bytes": ledger.transient_bytes,
+        })
+    return out
 
 
 # ---------------------------------------------------------------------------

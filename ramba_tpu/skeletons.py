@@ -45,6 +45,7 @@ from ramba_tpu.core.ndarray import ndarray
 from ramba_tpu.observe import registry as _registry
 from ramba_tpu.ops.creation import asarray
 from ramba_tpu.parallel import mesh as _mesh
+from ramba_tpu.resilience import memory as _gov_memory
 from ramba_tpu.utils import compat as _compat
 
 # ---------------------------------------------------------------------------
@@ -1457,7 +1458,11 @@ def spmd(func, *args):
                     f"via LocalView.local_valid or LocalView.valid_mask."
                 )
             v = jnp.pad(v, pads)
-        padded.append(jax.device_put(v, NamedSharding(mesh, spec)))
+        # Governor-accounted placement: these operand copies live outside
+        # the fuser's owner census, so a raw device_put here would dodge
+        # both admission control and peak-live bookkeeping.
+        padded.append(_gov_memory.governed_device_put(
+            v, NamedSharding(mesh, spec), site="spmd_pad"))
     vals = padded
 
     def _starts(spec, block_shape):

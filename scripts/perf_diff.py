@@ -53,6 +53,10 @@ _METRIC_DIRECTION = {
     "observe_flush_overhead_pct": "lower",
     "observe_scrape_ms": "lower",
     "coherence_overhead_ms": "lower",   # loopback agreement-round floor
+    "reshard_gb_per_s": "higher",       # staged layout-change collectives
+    "reshard_peak_live_bytes": "lower",  # ledger peak during the reshard
+    "live_reshape_ms": "lower",         # live mesh-reshape rung
+    "checkpoint_reshape_ms": "lower",   # drain->checkpoint->resume fallback
 }
 
 

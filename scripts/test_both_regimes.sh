@@ -26,3 +26,5 @@ echo "=== leg 10: backend autotune race (2-rank, same backend latched per finger
 python scripts/two_process_suite.py --autotune-leg
 echo "=== leg 11: 2-process rank-skewed chaos soak (coherent recovery) ==="
 python scripts/two_process_suite.py --chaos-leg
+echo "=== leg 12: staged resharding + live mesh elasticity (2-rank round-trip, 2->1 reshape) ==="
+python scripts/two_process_suite.py --reshard-leg

@@ -505,6 +505,21 @@ def _merge_line(e: dict) -> str:
         if e.get("step") is not None:
             line += f" step={e['step']}"
         return line
+    if t == "reshard":
+        a = e.get("action", "?")
+        line = f"reshard   {a} epoch={e.get('epoch', '?')}"
+        if a == "plan":
+            line += (f" stages={e.get('stages', '?')}"
+                     f" {_fmt_bytes(e.get('bytes', 0) or 0)}"
+                     f" peak<={_fmt_bytes(e.get('peak_bound_bytes', 0) or 0)}")
+        elif a == "stage":
+            line += (f" stage={e.get('stage', '?')}"
+                     f" {_fmt_bytes(e.get('bytes', 0) or 0)}")
+        elif a == "rollback":
+            line += f" ROLLBACK {str(e.get('error', ''))[:60]}"
+        else:
+            line += f" {_fmt_bytes(e.get('bytes', 0) or 0)}"
+        return line
     if t == "flush":
         return (f"flush     {e.get('label', '?')}"
                 f" rung={e.get('degraded', 'fused')}"
@@ -578,7 +593,7 @@ def merge_report(path: str, per_rank: dict, file=None, cap: int = 80) -> None:
         t = e.get("type")
         if t in ("fault", "degrade", "slow_flush", "cache_evict",
                  "flush_error", "health", "serve_coalesce", "stall",
-                 "lifecycle", "coherence"):
+                 "lifecycle", "coherence", "reshard"):
             return True
         if t == "memory":
             return not (e.get("action") == "admit" and e.get("ok"))

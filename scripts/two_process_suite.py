@@ -85,6 +85,20 @@ back: rank-local recovery diverges the rungs, the ranks' host gathers
 mispair, and the run ends in differing results / a wedged rank
 (deadline-killed) — demonstrating the protocol is what fixes it.
 
+``--reshard-leg`` runs the resharding/elasticity acceptance leg.
+Phase 1 (2-rank SPMD): a row-sharded array reshards to column-sharded
+then to replicated through the staged device-collective schedule
+(coherence plan fence + per-stage gates), asserted byte-identical on
+both ranks and within the ledger-verified peak-live bound; then a
+rank-skewed mid-reshard fault (``reshard:stage:after=2:rank=1``) must
+abort the epoch on BOTH ranks (the stage gate turns rank 1's local
+fault into a fleet-wide rollback before any collective mispairs),
+after which a clean retry ends byte-identical with zero watchdog
+stalls.  Phase 2 (single-rank): the same workload reshapes a 2-device
+mesh down to 1 device via ``elastic.live_reshape`` twice — once on the
+live rung, once with an injected ``reshard:plan`` fault forcing the
+drain→checkpoint→resume fallback — and the two digests must match.
+
 ``--telemetry-leg`` runs the live-telemetry acceptance leg: both ranks
 serve a traced ``serve.Session`` flush (one FIXED trace_id shared across
 ranks — the cross-rank causal chain), start the Prometheus exporter on
@@ -415,6 +429,255 @@ digest = hashlib.sha256(np.ascontiguousarray(np.asarray(x))
                         .tobytes()).hexdigest()
 print('ELASTIC_LEG_REF %s' % digest)
 """
+
+
+# SPMD workload for the reshard leg, phase 1: row → column → replicated
+# through the staged schedule, ledger-bound check, then a rank-skewed
+# mid-reshard fault that must roll back coherently on BOTH ranks.
+# argv: <rank> <coordinator>.
+_RESHARD_SPMD_WORKLOAD = """
+import sys
+import hashlib
+import numpy as np
+rank, coord = int(sys.argv[1]), sys.argv[2]
+from ramba_tpu.parallel import distributed
+distributed.initialize(coordinator_address=coord, num_processes=2,
+                       process_id=rank)
+import jax
+assert jax.process_count() == 2, jax.process_count()
+import ramba_tpu as rt
+from ramba_tpu.observe import registry
+from ramba_tpu.parallel import mesh as mesh_mod
+from ramba_tpu.parallel import reshard as reshard_mod
+from ramba_tpu.resilience import elastic, faults, memory
+ax = tuple(mesh_mod.get_mesh().axis_names)
+data = np.arange(512 * 64, dtype=np.float32).reshape(512, 64)
+ref = hashlib.sha256(np.ascontiguousarray(data).tobytes()).hexdigest()
+a = rt.asarray(data)
+rt.sync()
+cap = 1 << 13
+plan = reshard_mod.plan_reshard(a.shape, a.dtype, (ax,), (None,) + (ax,),
+                                max_stage_bytes=cap)
+assert len(plan.stages) > 1, plan.describe()
+live0 = memory.ledger.live_bytes + memory.ledger.transient_bytes
+peak0 = memory.ledger.peak_live_bytes
+rt.reshard(a, (None,) + (ax,), max_stage_bytes=cap)   # row -> column
+peak1 = memory.ledger.peak_live_bytes
+bound = (live0 - plan.total_bytes) + plan.peak_bound_bytes
+assert peak1 <= max(peak0, bound), (peak1, peak0, bound)
+rt.reshard(a, ())                                     # column -> replicated
+got = hashlib.sha256(np.ascontiguousarray(a.asarray())
+                     .tobytes()).hexdigest()
+assert got == ref, (got, ref)
+assert memory.ledger.transient_bytes == 0
+print('RESHARD_LEG_DIGEST rank=%d %s' % (rank, got), flush=True)
+print('RESHARD_LEG_PEAK rank=%d peak=%d bound=%d' % (rank, peak1, bound),
+      flush=True)
+# rank-skewed mid-reshard fault: rank 1 faults at stage 2; the stage
+# gate must turn that into a fleet-wide rollback on the SAME stage.
+rt.reshard(a, (ax,), max_stage_bytes=cap)             # back to row
+faults.configure('reshard:stage:after=2:rank=1')
+try:
+    rt.reshard(a, (None,) + (ax,), max_stage_bytes=cap)
+    raise SystemExit('expected ReshardError on rank %d' % rank)
+except reshard_mod.ReshardError:
+    pass
+faults.configure(None)
+assert registry.get('reshard.rollbacks') >= 1
+rt.reshard(a, (None,) + (ax,), max_stage_bytes=cap)   # clean retry
+rt.reshard(a, ())
+got2 = hashlib.sha256(np.ascontiguousarray(a.asarray())
+                      .tobytes()).hexdigest()
+assert got2 == ref, (got2, ref)
+stalls = elastic.report()['stalls']
+assert stalls == 0, stalls
+print('RESHARD_LEG_FAULT rank=%d digest=%s rollbacks=%d stalls=%d' % (
+    rank, got2, registry.get('reshard.rollbacks'), stalls), flush=True)
+"""
+
+
+# Reshard leg, phase 2: single rank, 2-device mesh reshaped down to 1
+# device in place.  argv: <mode> — 'live' runs the top rung, 'checkpoint'
+# injects a reshard:plan fault so the drain->checkpoint->resume fallback
+# must carry the reshape; both print the same-workload digest.
+_RESHARD_LIVE_WORKLOAD = """
+import sys
+import hashlib
+import time
+import numpy as np
+mode = sys.argv[1]
+import jax
+assert jax.process_count() == 1, jax.process_count()
+import ramba_tpu as rt
+from ramba_tpu.parallel import mesh as mesh_mod
+from ramba_tpu.resilience import elastic, faults
+mesh_mod.set_mesh(jax.sharding.Mesh(np.asarray(jax.devices()[:2]), ('d0',)))
+x = rt.arange(8192) * 1.0
+for step in (1, 2, 3):
+    x = x * 1.000001 + float(step)
+np.asarray(x)  # materialise on the 2-device mesh
+if mode == 'checkpoint':
+    faults.configure('reshard:plan:always')
+t0 = time.perf_counter()
+res = elastic.live_reshape(
+    jax.sharding.Mesh(np.asarray(jax.devices()[:1]), ('d0',)))
+wall_ms = (time.perf_counter() - t0) * 1000.0
+faults.configure(None)
+assert res['mode'] == mode, res
+assert mesh_mod.get_mesh().devices.size == 1
+for step in (4, 5, 6):
+    x = x * 1.000001 + float(step)
+digest = hashlib.sha256(np.ascontiguousarray(np.asarray(x))
+                        .tobytes()).hexdigest()
+print('RESHAPE_DIGEST mode=%s %s wall_ms=%.1f' % (mode, digest, wall_ms))
+"""
+
+
+def run_reshard_leg() -> int:
+    """2-rank staged reshard round-trip (byte-identical, ledger-bounded,
+    rank-skewed fault rolls back coherently), then a single-rank live
+    2-device -> 1-device mesh reshape byte-identical to the
+    checkpoint-fallback path."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    basetemp = tempfile.mkdtemp(prefix="ramba_2proc_reshard_")
+    trace_base = os.path.join(basetemp, "trace.jsonl")
+    budget = float(os.environ.get("RAMBA_TEST_PROCS_TIMEOUT", "600"))
+
+    def base_env():
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        for k in ("RAMBA_TEST_PROCS", "RAMBA_TEST_PROC_ID",
+                  "RAMBA_TEST_COORD", "RAMBA_TEST_SHARED_TMP",
+                  "RAMBA_PROFILE_DIR", "RAMBA_FAULTS", "RAMBA_HBM_BUDGET"):
+            env.pop(k, None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["RAMBA_TRACE"] = trace_base
+        # tripwire: a mispaired stage collective hangs, and that must
+        # fail the leg as a stall instead of wedging CI
+        env["RAMBA_WATCHDOG_S"] = "60"
+        return env
+
+    # --- phase 1: 2-rank SPMD round-trip + rank-skewed fault ---
+    procs, logs = [], []
+    for rank in range(2):
+        log = open(os.path.join(basetemp, f"rank{rank}.log"), "w")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _RESHARD_SPMD_WORKLOAD, str(rank),
+             f"localhost:{port}"],
+            env=base_env(), stdout=log, stderr=subprocess.STDOUT, cwd=REPO,
+        ))
+    deadline = time.time() + budget
+    rcs = [None, None]
+    try:
+        for i, p in enumerate(procs):
+            left = max(5.0, deadline - time.time())
+            try:
+                rcs[i] = p.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                rcs[i] = -9
+    finally:
+        for log in logs:
+            log.close()
+    ok = all(rc == 0 for rc in rcs)
+
+    digests, fault_digests = {}, {}
+    for rank in range(2):
+        path = os.path.join(basetemp, f"rank{rank}.log")
+        with open(path) as f:
+            tail = f.read().splitlines()
+        joined = "\n".join(tail)
+        for ln in tail:
+            if ln.startswith(f"RESHARD_LEG_DIGEST rank={rank} "):
+                digests[rank] = ln.split()[-1]
+            if ln.startswith(f"RESHARD_LEG_FAULT rank={rank} "):
+                fault_digests[rank] = ln.split("digest=")[1].split()[0]
+        if (f"RESHARD_LEG_DIGEST rank={rank}" not in joined
+                or f"RESHARD_LEG_FAULT rank={rank}" not in joined):
+            ok = False
+        print(f"--- reshard leg phase 1 rank {rank} rc={rcs[rank]} "
+              f"({path}) ---")
+        print("\n".join(tail[-(4 if ok else 40):]))
+    if ok and (digests[0] != digests[1]
+               or fault_digests[0] != fault_digests[1]):
+        print(f"reshard leg: FAIL (rank digests diverge: {digests}, "
+              f"post-fault {fault_digests})")
+        ok = False
+
+    # Per-rank traces must carry the reshard timeline: the fenced plan,
+    # its stages, and the coherent rollback from the fault phase.
+    import json
+
+    if ok:
+        for rank in range(2):
+            path = f"{trace_base}.rank{rank}"
+            try:
+                with open(path) as f:
+                    evs = [json.loads(ln) for ln in f if ln.strip()]
+                n_plan = sum(1 for e in evs if e.get("type") == "reshard"
+                             and e.get("action") == "plan")
+                n_stage = sum(1 for e in evs if e.get("type") == "reshard"
+                              and e.get("action") == "stage")
+                n_roll = sum(1 for e in evs if e.get("type") == "reshard"
+                             and e.get("action") == "rollback")
+                n_stall = sum(1 for e in evs if e.get("type") == "stall")
+                print(f"reshard leg rank {rank}: {n_plan} plans, "
+                      f"{n_stage} stages, {n_roll} rollbacks, "
+                      f"{n_stall} stalls")
+                if n_plan < 6 or n_stage < 6 or n_roll != 1 or n_stall:
+                    print(f"reshard leg rank {rank}: FAIL (timeline "
+                          f"plan={n_plan} stage={n_stage} roll={n_roll} "
+                          f"stall={n_stall})")
+                    ok = False
+            except (OSError, ValueError) as e:
+                print(f"reshard leg rank {rank}: FAIL ({e})")
+                ok = False
+
+    # --- phase 2: single-rank live 2->1 reshape vs checkpoint path ---
+    reshape = {}
+    if ok:
+        for mode in ("live", "checkpoint"):
+            env = base_env()
+            env.pop("RAMBA_TRACE", None)
+            r = subprocess.run(
+                [sys.executable, "-c", _RESHARD_LIVE_WORKLOAD, mode],
+                env=env, capture_output=True, text=True, cwd=REPO,
+                timeout=budget,
+            )
+            print(f"--- reshard leg reshape[{mode}] rc={r.returncode} ---")
+            out = r.stdout.splitlines()
+            print("\n".join(out[-4:]) if r.returncode == 0
+                  else (r.stdout + r.stderr))
+            if r.returncode != 0:
+                ok = False
+                continue
+            for ln in out:
+                if ln.startswith(f"RESHAPE_DIGEST mode={mode} "):
+                    reshape[mode] = ln.split()[2]
+            if mode not in reshape:
+                print(f"reshard leg: FAIL (no digest from {mode} reshape)")
+                ok = False
+    if ok:
+        if reshape["live"] != reshape["checkpoint"]:
+            print(f"reshard leg: FAIL (live reshape digest "
+                  f"{reshape['live']} != checkpoint path "
+                  f"{reshape['checkpoint']})")
+            ok = False
+        else:
+            print(f"reshard leg: live 2->1 mesh reshape is byte-identical "
+                  f"to the checkpoint path "
+                  f"(sha256 {reshape['live'][:16]}...)")
+
+    print(f"two-process reshard leg: {'OK' if ok else 'FAIL'}")
+    if ok:
+        shutil.rmtree(basetemp, ignore_errors=True)
+    else:
+        print(f"reshard leg artifacts kept at {basetemp}")
+    return 0 if ok else 1
 
 
 def run_elastic_leg() -> int:
@@ -1351,6 +1614,8 @@ def main() -> int:
         return run_serving_leg()
     if "--elastic-leg" in sys.argv[1:]:
         return run_elastic_leg()
+    if "--reshard-leg" in sys.argv[1:]:
+        return run_reshard_leg()
     if "--telemetry-leg" in sys.argv[1:]:
         return run_telemetry_leg()
     if "--autotune-leg" in sys.argv[1:]:

@@ -1,0 +1,259 @@
+"""Device-side resharding collectives + live mesh elasticity.
+
+Covers ``ramba_tpu.parallel.reshard`` and its integration seams:
+
+* schedule construction: single-stage vs byte-bounded slab staging, the
+  peak-live bound arithmetic (src + dst + one in-flight slab), and the
+  31-bit plan hash the coherence fence broadcasts,
+* round-trip resharding (row → column → replicated → row) asserted
+  byte-identical, with ``reshard.*`` counters and the ledger's
+  transient-byte accounting settling back to zero,
+* rollback on an injected ``reshard:stage`` fault: the source array is
+  untouched (same bytes, same layout) and the schedule is re-runnable,
+* the rewrite rule that aligns disagreeing operand layouts with an
+  inserted reshard (shard_hint) instead of falling back to replication,
+* resharding a spilled array (restore-from-host then stage),
+* governor-accounted ``device_put`` (the skeletons padded-operand seam),
+* local live mesh reshape: live rung byte-identical, fault-forced
+  checkpoint-fallback rung byte-identical, ladder counters.
+"""
+
+import gc
+
+import numpy as np
+import pytest
+
+import jax as _jax
+import ramba_tpu as rt
+from ramba_tpu.core import rewrite
+from ramba_tpu.observe import registry
+from ramba_tpu.parallel import mesh as mesh_mod
+from ramba_tpu.parallel import reshard as reshard_mod
+from ramba_tpu.resilience import elastic, faults, memory, spill
+
+_MULTIPROC = _jax.process_count() > 1
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.setenv("RAMBA_RETRY_BASE_S", "0.001")
+    monkeypatch.delenv("RAMBA_HBM_BUDGET", raising=False)
+    monkeypatch.delenv("RAMBA_RESHARD_STAGE_BYTES", raising=False)
+    faults.configure(None)
+    yield
+    faults.reset()
+
+
+def _axes():
+    return tuple(mesh_mod.get_mesh().axis_names)
+
+
+# -- schedule construction ---------------------------------------------------
+
+
+def test_plan_single_stage_when_under_budget():
+    p = reshard_mod.plan_reshard((8, 8), np.float32, (), (("d0",),),
+                                 max_stage_bytes=1 << 20)
+    assert len(p.stages) == 1
+    assert p.total_bytes == 8 * 8 * 4
+    assert p.stages[0].nbytes == p.total_bytes
+    # single stage: whole src + whole dst live at once
+    assert p.peak_bound_bytes == 2 * p.total_bytes
+
+
+def test_plan_staged_slab_math():
+    shape, cap = (128, 64), 1 << 12
+    p = reshard_mod.plan_reshard(shape, np.float32, (("d0",),),
+                                 ((None, ("d1",))), max_stage_bytes=cap)
+    assert len(p.stages) > 1
+    assert p.axis == 0  # longest dim
+    # slabs tile the axis exactly, in order, without overlap
+    assert p.stages[0].lo == 0
+    assert p.stages[-1].hi == shape[0]
+    for a, b in zip(p.stages, p.stages[1:]):
+        assert a.hi == b.lo
+    assert sum(s.nbytes for s in p.stages) == p.total_bytes
+    assert all(s.nbytes <= cap for s in p.stages)
+    assert p.max_stage_bytes == max(s.nbytes for s in p.stages)
+    # bound: src + dst + one in-flight slab
+    assert p.peak_bound_bytes == 2 * p.total_bytes + p.max_stage_bytes
+
+
+def test_plan_hash_is_31_bit_and_layout_sensitive():
+    a = reshard_mod.plan_reshard((64, 32), np.float32, (("d0",),),
+                                 ((None, ("d1",))))
+    b = reshard_mod.plan_reshard((64, 32), np.float32, (("d0",),),
+                                 ((None, ("d1",))))
+    c = reshard_mod.plan_reshard((64, 32), np.float32, (("d0",),), ())
+    assert a.hash31() == b.hash31()
+    assert a.hash31() != c.hash31()
+    for p in (a, c):
+        assert 0 <= p.hash31() < 2 ** 31
+
+
+def test_stage_bytes_env_floor(monkeypatch):
+    monkeypatch.setenv("RAMBA_RESHARD_STAGE_BYTES", "1")
+    assert reshard_mod.default_stage_bytes() == 1 << 16  # floored
+    monkeypatch.setenv("RAMBA_RESHARD_STAGE_BYTES", "2m")
+    assert reshard_mod.default_stage_bytes() == 2 << 20
+
+
+# -- execution ---------------------------------------------------------------
+
+
+@pytest.mark.skipif(_MULTIPROC, reason="single-controller layout checks")
+def test_roundtrip_byte_identical_with_counters():
+    ax = _axes()
+    data = np.arange(64 * 32, dtype=np.float32).reshape(64, 32)
+    a = rt.asarray(data)
+    before = registry.get("reshard.completed")
+    rt.reshard(a, (None, ax))          # row → column
+    assert np.array_equal(np.asarray(a), data)
+    rt.reshard(a, ())                  # column → replicated
+    assert np.array_equal(np.asarray(a), data)
+    rt.reshard(a, (ax,))               # replicated → row
+    assert np.array_equal(np.asarray(a), data)
+    assert registry.get("reshard.completed") >= before + 3
+    assert memory.ledger.transient_bytes == 0
+
+
+@pytest.mark.skipif(_MULTIPROC, reason="single-controller layout checks")
+def test_staged_execution_bounded_and_identical():
+    ax = _axes()
+    data = np.arange(256 * 64, dtype=np.float32).reshape(256, 64)
+    a = rt.asarray(data)
+    plan = reshard_mod.plan_reshard(a.shape, a.dtype, (ax,), (None, ax),
+                                    max_stage_bytes=1 << 12)
+    assert len(plan.stages) > 1
+    s0 = registry.get("reshard.stages")
+    rt.reshard(a, (None, ax), max_stage_bytes=1 << 12)
+    assert np.array_equal(np.asarray(a), data)
+    assert registry.get("reshard.stages") - s0 == len(plan.stages)
+    assert memory.ledger.transient_bytes == 0
+    # the ledger's high-water mark saw the transfer go through
+    assert memory.ledger.peak_live_bytes >= data.nbytes
+
+
+@pytest.mark.skipif(_MULTIPROC, reason="fault is asserted in-process")
+def test_rollback_on_stage_fault_leaves_source_intact():
+    ax = _axes()
+    data = np.arange(256 * 64, dtype=np.float32).reshape(256, 64)
+    a = rt.asarray(data)
+    np.asarray(a)  # materialise the source layout
+    faults.configure("reshard:stage:after=2")
+    r0 = registry.get("reshard.rollbacks")
+    with pytest.raises(reshard_mod.ReshardError, match="sharding intact"):
+        rt.reshard(a, (None, ax), max_stage_bytes=1 << 12)
+    assert registry.get("reshard.rollbacks") == r0 + 1
+    # source untouched: same bytes, and the schedule re-runs clean
+    assert np.array_equal(np.asarray(a), data)
+    faults.configure(None)
+    rt.reshard(a, (None, ax), max_stage_bytes=1 << 12)
+    assert np.array_equal(np.asarray(a), data)
+    assert memory.ledger.transient_bytes == 0
+
+
+def test_views_are_rejected():
+    a = rt.asarray(np.arange(64, dtype=np.float32).reshape(8, 8))
+    v = a[2:6]
+    with pytest.raises(ValueError, match="views"):
+        rt.reshard(v, ())
+
+
+@pytest.mark.skipif(_MULTIPROC, reason="spill requires fully-addressable "
+                                       "shards")
+def test_spilled_array_reshards_after_restore():
+    ax = _axes()
+    data = np.arange(64 * 32, dtype=np.float32).reshape(64, 32)
+    a = rt.asarray(data)
+    np.asarray(a)
+    memory.ledger.evict_until(memory.ledger.live_bytes or 1)
+    assert isinstance(a._expr.value, spill.SpilledArray)
+    rt.reshard(a, (None, ax))
+    assert not isinstance(a._expr.value, spill.SpilledArray)
+    assert np.array_equal(np.asarray(a), data)
+
+
+# -- rewrite-inserted reshard ------------------------------------------------
+
+
+@pytest.mark.skipif(_MULTIPROC, reason="single-controller layout checks")
+def test_rewrite_aligns_disagreeing_operand_layouts():
+    ax = _axes()
+    da = np.arange(64 * 32, dtype=np.float32).reshape(64, 32)
+    db = np.linspace(0.0, 1.0, 64 * 32, dtype=np.float32).reshape(64, 32)
+    a = rt.asarray(da)
+    b = rt.asarray(db)
+    rt.reshard(b, (None, ax))  # now a and b disagree on layout
+    n0 = rewrite.stats.get("rewrite_align_operand_layouts", 0)
+    c = a + b
+    got = np.asarray(c)
+    assert rewrite.stats.get("rewrite_align_operand_layouts", 0) == n0 + 1
+    np.testing.assert_allclose(got, da + db, rtol=1e-6)
+
+
+# -- governed device_put -----------------------------------------------------
+
+
+@pytest.mark.skipif(_MULTIPROC, reason="transient accounting is "
+                                       "asserted in-process")
+def test_governed_device_put_accounts_transient_bytes():
+    data = np.arange(1024, dtype=np.float32)
+    g0 = registry.get("memory.governed_puts")
+    out = memory.governed_device_put(data, site="test")
+    assert np.array_equal(np.asarray(out), data)
+    assert registry.get("memory.governed_puts") == g0 + 1
+    assert memory.ledger.transient_bytes >= data.nbytes
+    del out
+    gc.collect()
+    assert memory.ledger.transient_bytes == 0
+    assert "transient_bytes" in memory.ledger.snapshot()
+
+
+# -- live mesh reshape -------------------------------------------------------
+
+
+def _submesh(n):
+    devs = np.asarray(_jax.devices()[:n])
+    return _jax.sharding.Mesh(devs, ("d0",))
+
+
+@pytest.mark.skipif(_MULTIPROC, reason="local mesh surgery")
+def test_live_reshape_live_rung_byte_identical():
+    old = mesh_mod.get_mesh()
+    if old.devices.size < 2:
+        pytest.skip("needs >= 2 local devices")
+    data = np.arange(64 * 32, dtype=np.float32).reshape(64, 32)
+    a = rt.asarray(data)
+    np.asarray(a)
+    try:
+        res = elastic.live_reshape(_submesh(2))
+        assert res["mode"] == "live"
+        assert dict(mesh_mod.get_mesh().shape) == {"d0": 2}
+        assert np.array_equal(np.asarray(a), data)
+        assert len(a._value().sharding.device_set) == 2
+        # compute proceeds on the new mesh
+        np.testing.assert_allclose(np.asarray(a + 1.0), data + 1.0)
+    finally:
+        mesh_mod.set_mesh(old)
+    assert elastic.report()["live_reshapes"] >= 1
+
+
+@pytest.mark.skipif(_MULTIPROC, reason="local mesh surgery")
+def test_live_reshape_fault_falls_back_to_checkpoint(tmp_path):
+    old = mesh_mod.get_mesh()
+    if old.devices.size < 2:
+        pytest.skip("needs >= 2 local devices")
+    data = np.arange(32 * 16, dtype=np.float32).reshape(32, 16)
+    a = rt.asarray(data)
+    np.asarray(a)
+    faults.configure("reshard:plan:always")
+    try:
+        res = elastic.live_reshape(_submesh(2), manager=str(tmp_path))
+        assert res["mode"] == "checkpoint"
+        assert dict(mesh_mod.get_mesh().shape) == {"d0": 2}
+        assert np.array_equal(np.asarray(a), data)
+    finally:
+        faults.configure(None)
+        mesh_mod.set_mesh(old)
+    assert elastic.report()["reshape_fallbacks"] >= 1
