@@ -378,6 +378,77 @@ def _bench_serving(rt, platform):
     }
 
 
+def _bench_memo(rt, platform):
+    """Result-memoization section (core/memo.py, RAMBA_MEMO).  Two
+    numbers feed scripts/perf_diff.py: ``memo_hit_rate`` (fraction of
+    certified lookups served from the result cache on a
+    repeated-subgraph loop over stable inputs — the cross-flush dedup
+    the cache exists for) and ``serving_dup_execs`` (duplicate
+    executions that escaped batch CSE when concurrent tenants submit
+    the same canonical subgraph — 0 means every duplicate merged)."""
+    import os
+    import threading
+
+    from ramba_tpu import serve
+    from ramba_tpu.core import memo as _memo
+    from ramba_tpu.observe import registry as _registry
+
+    saved = os.environ.get("RAMBA_MEMO")
+    os.environ["RAMBA_MEMO"] = "1"
+    _memo.reset()
+    out = {}
+    try:
+        n = 262_144 if platform != "cpu" else 16_384
+        base = rt.arange(n) / 7.0
+        other = rt.arange(n) * 3.0
+        rt.sync()  # stable input buffers: every repeat is a would-be hit
+        reps = 20
+        for _ in range(reps):
+            r = base * 2.0 + other
+            r.asarray()
+            del r
+        snap = _memo.cache.snapshot()
+        out["memo_hit_rate"] = snap["hit_rate"]
+        out["memo_entries"] = snap["entries"]
+
+        # serving leg: concurrent tenants submit the SAME canonical
+        # subgraph; the pipeline's batch CSE should give one execution
+        # plus memo-served followers
+        dup0 = _registry.get("serve.dup_execs")
+        cse0 = _registry.get("serve.cse_merged")
+        n_sessions, per_session = 3, 8
+        errs = []
+
+        def worker(i):
+            try:
+                with serve.Session(tenant=f"memo{i}") as s:
+                    for _ in range(per_session):
+                        r = base + other
+                        s.flush(wait=True)
+                        del r
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e)[:200])
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_sessions)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        serve.shutdown()
+        if errs:
+            raise RuntimeError("; ".join(errs[:3]))
+        out["serving_dup_execs"] = _registry.get("serve.dup_execs") - dup0
+        out["serving_cse_merges"] = _registry.get("serve.cse_merged") - cse0
+    finally:
+        if saved is None:
+            os.environ.pop("RAMBA_MEMO", None)
+        else:
+            os.environ["RAMBA_MEMO"] = saved
+        _memo.reset()
+    return out
+
+
 def _bench_observe(rt, platform):
     """Observability-plane cost section (PAY-FOR-WHAT-YOU-SEE check).
     Three numbers feed scripts/perf_diff.py: ``observe_events_per_s``
@@ -760,6 +831,11 @@ def main():
             out.update(_bench_serving(rt, platform))
         except Exception:  # noqa: BLE001
             out["serving_error"] = traceback.format_exc(limit=2)[-300:]
+
+        try:
+            out.update(_bench_memo(rt, platform))
+        except Exception:  # noqa: BLE001
+            out["memo_error"] = traceback.format_exc(limit=2)[-300:]
 
         try:
             out.update(_bench_observe(rt, platform))

@@ -15,6 +15,18 @@ See docs/index.md "Static analysis & RAMBA_VERIFY" for the rule catalog.
 
 from __future__ import annotations
 
+from ramba_tpu.analyze.canon import (
+    COMMUTATIVE,
+    CanonForm,
+    NotCanonical,
+    canonicalize,
+    try_canonicalize,
+)
+from ramba_tpu.analyze.effects import (
+    EffectReport,
+    classify_program,
+    static_token,
+)
 from ramba_tpu.analyze.findings import (
     SEVERITIES,
     Finding,
@@ -31,14 +43,22 @@ from ramba_tpu.analyze.verifier import (
 )
 
 __all__ = [
-    "SEVERITIES",
+    "COMMUTATIVE",
+    "CanonForm",
+    "EffectReport",
     "Finding",
+    "NotCanonical",
     "ProgramVerificationError",
     "ProgramView",
     "RULES",
+    "SEVERITIES",
     "analyze_exprs",
+    "canonicalize",
+    "classify_program",
     "enabled_rules",
     "mode",
+    "static_token",
+    "try_canonicalize",
     "verify_flush",
     "verify_program",
 ]

@@ -4,6 +4,7 @@ Usage (equivalently via ``scripts/ramba_lint.py``)::
 
     python -m ramba_tpu.analyze /tmp/trace.jsonl [more.jsonl ...]
     python -m ramba_tpu.analyze --json --strict trace.jsonl
+    python -m ramba_tpu.analyze --memo-audit trace.jsonl
 
 Consumes the trace a run wrote under ``RAMBA_TRACE=<path>`` (per-rank
 ``.rank*`` siblings are auto-discovered).  Two sources of diagnostics:
@@ -163,6 +164,103 @@ def render(
     return offline
 
 
+def memo_audit(
+    events: Sequence[Dict[str, Any]],
+    file: Optional[TextIO] = None,
+    top: int = 10,
+) -> Dict[str, Any]:
+    """Replay a trace's ``program`` events through the effect certifier
+    and canonical hasher, and report the recurring canonical subgraphs a
+    result cache (``RAMBA_MEMO``) would have deduplicated.  The
+    would-be hit rate assumes stable inputs (every repeat of a
+    memoizable canonical form after the first is a hit) — an upper
+    bound that sizes ``RAMBA_MEMO_BUDGET``, not a promise."""
+    from ramba_tpu.analyze import canon as _canon
+    from ramba_tpu.analyze import effects as _effects
+
+    out = file or sys.stdout
+    # mean out_bytes per label, from the flush spans, to size the budget
+    label_bytes: Dict[str, List[int]] = {}
+    for ev in events:
+        if ev.get("type") == "flush" and "out_bytes" in ev:
+            label_bytes.setdefault(str(ev.get("label", "?")), []).append(
+                int(ev["out_bytes"]))
+
+    groups: Dict[str, Dict[str, Any]] = {}
+    total = unreadable = 0
+    for ev in events:
+        if ev.get("type") != "program":
+            continue
+        total += 1
+        label = str(ev.get("label", "?"))
+        try:
+            prog = _RecordedProgram(ev)
+            form = _canon.try_canonicalize(prog)
+            rep = _effects.classify_program(
+                prog, tuple(ev.get("donate", ())))
+        except Exception:
+            unreadable += 1
+            continue
+        chash = form.chash if form is not None else f"<uncanonical:{label}>"
+        g = groups.setdefault(chash, {
+            "chash": chash, "count": 0, "labels": Counter(),
+            "memoizable": form is not None and rep.memoizable,
+            "reason": rep.reason,
+        })
+        g["count"] += 1
+        g["labels"][label] += 1
+        if not (form is not None and rep.memoizable):
+            g["memoizable"] = False
+            g["reason"] = rep.reason if rep.reason != "ok" else "uncanonical"
+
+    would_hits = resident_bytes = 0
+    for g in groups.values():
+        sizes = [b for lbl, n in g["labels"].items()
+                 for b in label_bytes.get(lbl, [])]
+        g["mean_out_bytes"] = int(sum(sizes) / len(sizes)) if sizes else 0
+        if g["memoizable"]:
+            would_hits += g["count"] - 1
+            resident_bytes += g["mean_out_bytes"]
+    rate = would_hits / total if total else 0.0
+
+    print("== memo audit ==", file=out)
+    print(
+        f"programs: {total}  canonical groups: {len(groups)}  "
+        f"would-be hits: {would_hits}  would-be hit rate: {rate:.1%}"
+        + (f"  unreadable: {unreadable}" if unreadable else ""),
+        file=out,
+    )
+    ranked = sorted(groups.values(), key=lambda g: -g["count"])[:top]
+    for g in ranked:
+        label, _n = g["labels"].most_common(1)[0]
+        verdict = ("memoizable" if g["memoizable"]
+                   else f"uncacheable ({g['reason']})")
+        print(
+            f"  {g['chash']:<18s} x{g['count']:<5d} {verdict:<28s} "
+            f"~{g['mean_out_bytes']}B/result  e.g. {label}",
+            file=out,
+        )
+    if resident_bytes:
+        print(
+            f"budget guidance: one resident result per memoizable group "
+            f"needs ~{resident_bytes} bytes — set RAMBA_MEMO_BUDGET at or "
+            f"above this (default 256m) to avoid thrash",
+            file=out,
+        )
+    elif total and not would_hits:
+        print("no recurring memoizable subgraphs — RAMBA_MEMO would not "
+              "help this workload", file=out)
+    return {
+        "programs": total,
+        "groups": len(groups),
+        "would_hits": would_hits,
+        "would_hit_rate": round(rate, 4),
+        "resident_bytes": resident_bytes,
+        "top": [{k: (dict(v) if isinstance(v, Counter) else v)
+                 for k, v in g.items()} for g in ranked],
+    }
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="ramba-lint",
@@ -175,6 +273,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="emit findings as JSON lines instead of text")
     ap.add_argument("--strict", action="store_true",
                     help="exit 1 if any error-severity finding exists")
+    ap.add_argument("--memo-audit", action="store_true",
+                    help="report recurring canonical subgraphs and the "
+                         "would-be RAMBA_MEMO hit rate")
     args = ap.parse_args(argv)
 
     files: List[str] = []
@@ -188,6 +289,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     any_error = False
     for path in files:
         events = load_events(path)
+        if args.memo_audit:
+            if args.json:
+                audit = memo_audit(events, file=open(os.devnull, "w"))
+                print(json.dumps({"trace": path, **audit}))
+            else:
+                print(f"== ramba-lint {path} ==")
+                memo_audit(events)
+            continue
         if args.json:
             offline = lint_events(events)
             for label, f in offline:

@@ -24,8 +24,19 @@ Rules
                        sharding hints naming axes the live mesh lacks.
 ``graph-hygiene``      dangling slot references, cycles (manifest as
                        forward references in a linearization), dead
-                       subgraphs, and compile-cache key collisions (two
-                       trace-time semantic contexts mapping to one key).
+                       subgraphs — including dead RNG draws (an entropy
+                       consumption no output observes, the
+                       ``dead-entropy`` finding) — and cache key
+                       collisions, both compile-cache (two trace-time
+                       semantic contexts mapping to one structural key)
+                       and canonical-hash (two canonical *forms* mapping
+                       to one truncated semantic hash).
+``memo-safety``        a result-cache plan (``core/memo.py``) claiming
+                       memoizability for a program whose re-derived
+                       effect class is not pure/RNG-keyed, that donates
+                       an input, or whose result alias-escapes an input
+                       — the seeded violation of the ``memo:insert`` /
+                       ``memo:hit`` fault sites.
 """
 
 from __future__ import annotations
@@ -488,9 +499,111 @@ def check_hygiene(view: "ProgramView") -> List[Finding]:
                 f"{len(dead)} instruction(s) feed no program output "
                 f"(dead subgraph): {ops}",
             ))
+        from ramba_tpu.analyze.effects import RNG_OPS
+
+        for i in dead:
+            if prog.instrs[i][0] in RNG_OPS:
+                fs.append(Finding(
+                    "graph-hygiene", "warning",
+                    f"instr{i}:{prog.instrs[i][0]}",
+                    "dead-entropy: RNG draw whose output no program "
+                    "output consumes — the PRNG key was advanced for a "
+                    "sample nothing observes (usually a dropped array "
+                    "or an over-split key)",
+                ))
     fs.extend(check_cache_key(
         prog, view.donate,
         key_fn=view.key_fn, fingerprint=view.fingerprint,
         registry=view.key_registry,
     ))
+    fs.extend(check_canon_collision(
+        prog, view.memo_plan, registry=view.canon_registry,
+    ))
+    return fs
+
+
+# ---------------------------------------------------------------------------
+# canonical-hash collision + result-memoization safety
+# ---------------------------------------------------------------------------
+
+# canonical hash -> canonical form under which it was first seen.  The
+# canonical-hash analog of _cache_key_registry: the hash is a truncated
+# digest of the form, so two different forms under one hash is a real
+# (if astronomically unlikely) collision — and a result-cache keyed on
+# that hash would serve one program's bytes for the other.
+_canon_registry: Dict[str, str] = {}
+_CANON_REGISTRY_MAX = 4096
+
+
+def check_canon_collision(
+    program: Any,
+    memo_plan: Any = None,
+    *,
+    registry: Optional[MutableMapping[str, str]] = None,
+) -> List[Finding]:
+    """Detect canonical-hash collisions: the same semantic hash observed
+    for two different canonical *forms*.  Cheap when a memo plan already
+    carries the canonicalization (the flush path); programs without a
+    plan are only canonicalized when they are canonicalizable at all."""
+    if registry is None:
+        registry = _canon_registry
+    chash = getattr(memo_plan, "chash", None)
+    form = getattr(memo_plan, "form", None)
+    if chash is None or form is None:
+        from ramba_tpu.analyze import canon as _canon
+
+        cf = _canon.try_canonicalize(program)
+        if cf is None:
+            return []
+        chash, form = cf.chash, cf.form
+    prev = registry.get(chash)
+    if prev is not None and prev != form:
+        return [Finding(
+            "graph-hygiene", "error", "program",
+            f"canonical-hash collision: hash {chash} maps to two "
+            "different canonical forms — a result cache keyed on it "
+            "would serve one program's bytes for the other",
+        )]
+    if len(registry) > _CANON_REGISTRY_MAX:
+        registry.clear()
+    registry[chash] = form
+    return []
+
+
+@rule("memo-safety")
+def check_memo_safety(view: "ProgramView") -> List[Finding]:
+    """Audit a flush's result-memoization plan: re-derive the effect and
+    alias analysis *independently* of the plan (the certifier that
+    produced the plan may have been corrupted — that is exactly what the
+    ``memo:insert``/``memo:hit`` fault sites do) and flag any claim of
+    memoizability the re-derivation rejects.  No plan, or a plan that
+    already declined to memoize, is vacuously safe."""
+    fs: List[Finding] = []
+    plan = view.memo_plan
+    prog = view.program
+    if plan is None or prog is None or not getattr(plan, "memoizable",
+                                                   False):
+        return fs
+    from ramba_tpu.analyze.effects import classify_program
+
+    rep = classify_program(prog, tuple(view.donate))
+    for i, why in rep.host_instrs:
+        op = prog.instrs[i][0]
+        fs.append(Finding(
+            "memo-safety", "error", f"instr{i}:{op}",
+            f"result cache admitted a host-effecting subgraph ({why}); "
+            "replaying its cached bytes could diverge from re-execution",
+        ))
+    for s in rep.alias_outs:
+        fs.append(Finding(
+            "memo-safety", "error", f"slot{s}",
+            "memoized result aliases a program input: caching it would "
+            "hand later flushes a caller-visible buffer",
+        ))
+    if rep.donating:
+        fs.append(Finding(
+            "memo-safety", "error", "program",
+            "memoized program donates input buffers; a replayed hit "
+            "would skip the donation the alias census already assumed",
+        ))
     return fs

@@ -75,6 +75,11 @@ class ProgramView:
     key_fn: Optional[Callable[[Any, tuple], Any]] = None
     fingerprint: Optional[Any] = None
     key_registry: Optional[MutableMapping[Any, Any]] = None
+    # result-memoization audit surface: the flush's core/memo.py plan
+    # (memo-safety rule input) and the canonical-hash collision registry
+    # override (None means the process-wide one in rules.py)
+    memo_plan: Any = None
+    canon_registry: Optional[MutableMapping[str, str]] = None
 
 
 def verify_program(
@@ -105,10 +110,13 @@ def verify_flush(
     exprs: Sequence[Any],
     donate: Sequence[int],
     label: Optional[str] = None,
+    memo_plan: Any = None,
 ) -> List[Finding]:
     """Verify the program a flush is about to execute, emitting each
     finding through ``observe/events.py`` (so ``trace_report.py`` renders
-    them) and counting per-severity registry metrics."""
+    them) and counting per-severity registry metrics.  ``memo_plan`` is
+    the flush's result-memoization plan, audited by the memo-safety
+    rule."""
     from ramba_tpu import common as _common
     from ramba_tpu.core import fuser as _fuser
 
@@ -119,6 +127,7 @@ def verify_flush(
         donate=tuple(donate),
         owners=_fuser._leaf_owner_counts(leaves),
         seg_size=_common.max_program_instrs,
+        memo_plan=memo_plan,
     )
     findings = verify_program(view)
     for f in findings:

@@ -53,6 +53,7 @@ from typing import Optional, Sequence
 import jax
 
 from ramba_tpu import common
+from ramba_tpu.core import memo as _memo
 from ramba_tpu.core.expr import Const, Expr, Node, Scalar, OPS
 from ramba_tpu.observe import events as _events
 from ramba_tpu.observe import ledger as _ledger
@@ -1218,7 +1219,7 @@ def _program_event(program: _Program, leaves, donate_key: tuple,
 
 
 def _verify_if_enabled(program: _Program, leaves, exprs, donate_key: tuple,
-                       span: dict, label: str) -> bool:
+                       span: dict, label: str, memo_plan=None) -> bool:
     """RAMBA_VERIFY hook: statically verify the program about to execute
     (see ramba_tpu.analyze).  Strict mode raises ProgramVerificationError
     on error findings — before ``_get_compiled`` is ever reached, so a
@@ -1234,7 +1235,7 @@ def _verify_if_enabled(program: _Program, leaves, exprs, donate_key: tuple,
     if vmode == "off":
         return False
     findings = _verifier.verify_flush(program, leaves, exprs, donate_key,
-                                      label=label)
+                                      label=label, memo_plan=memo_plan)
     if findings:
         counts: dict = {}
         for f in findings:
@@ -1266,7 +1267,8 @@ class _FlushWork:
     __slots__ = ("stream", "roots", "root_exprs", "extra_n", "program",
                  "leaves", "vexprs", "leaf_vals", "donate_key", "span",
                  "label", "fingerprint", "skip_fused", "pins", "flight",
-                 "t_flush", "detached", "enqueued_at")
+                 "t_flush", "detached", "enqueued_at", "memo_plan",
+                 "memo_hit")
 
     def __init__(self, stream, roots, extra_n):
         self.stream = stream
@@ -1287,6 +1289,10 @@ class _FlushWork:
         self.t_flush = 0.0
         self.detached = False
         self.enqueued_at = None
+        # result memoization (core/memo.py): the certified plan, and the
+        # cached output values when a lookup already hit
+        self.memo_plan = None
+        self.memo_hit = None
 
 
 def _gather_leaf_vals(leaves):
@@ -1462,6 +1468,14 @@ def _flush_prepare(stream: FlushStream, roots: list,
         # (or oom-triggered) eviction during THIS flush must not pull a
         # buffer the program is about to read.
         work.pins = _memory.ledger.pin_values(leaf_vals)
+        # Result-memoization certification (RAMBA_MEMO; None when off or
+        # the program is provably uncacheable).  The plan is built before
+        # the verifier runs so the memo-safety rule audits it.
+        try:
+            work.memo_plan = _memo.plan_for(program, donate_key, leaves,
+                                            leaf_vals)
+        except Exception:
+            work.memo_plan = None
     except Exception as e:
         if detached:
             _quarantine(work, e)
@@ -1469,13 +1483,24 @@ def _flush_prepare(stream: FlushStream, roots: list,
         raise
     try:
         work.skip_fused = _verify_if_enabled(
-            program, leaves, vexprs, donate_key, span, label
+            program, leaves, vexprs, donate_key, span, label,
+            memo_plan=work.memo_plan,
         )
     except Exception as e:
         _quarantine(work, e)
         _release(work)
         raise
+    if work.skip_fused:
+        # a verifier-distrusted flush must not populate (or consult) the
+        # result cache: whatever routed it down the ladder may be the
+        # very defect the memo-safety rule flagged
+        work.memo_plan = None
     work.fingerprint = _ledger.fingerprint(_cache_key(program, donate_key))
+    if work.memo_plan is not None:
+        try:
+            work.memo_hit = _memo.lookup(work.memo_plan)
+        except Exception:
+            work.memo_hit = None
     return work
 
 
@@ -1496,6 +1521,45 @@ def _revalidate_donation(work: "_FlushWork") -> None:
         work.span["donated"] = len(kept)
         work.fingerprint = _ledger.fingerprint(
             _cache_key(work.program, kept))
+
+
+def _finish_memo_hit(work: "_FlushWork") -> list:
+    """Complete a flush whose outputs the result cache already holds:
+    no admission, no compile, no execution — just write-back and span
+    bookkeeping.  The span carries ``cache="memo"`` so trace tooling can
+    tell a memo hit from a compile-cache hit, and the slow-flush ledger
+    is deliberately NOT fed (a near-zero memo wall would poison the
+    program's rolling latency history)."""
+    stream, span, program = work.stream, work.span, work.program
+    outs = work.memo_hit
+    work.memo_hit = None
+    _release(work)
+    with _stats_lock:
+        stats["flushes"] += 1
+        stats["nodes_flushed"] += len(program.instrs)
+    stream.stats["flushes"] += 1
+    stream.stats["nodes_flushed"] += len(program.instrs)
+    _registry.inc("fuser.flushes")
+    _registry.inc("fuser.nodes_flushed", len(program.instrs))
+    if stream.tenant is not None:
+        _registry.inc(f"serve.tenant.{stream.tenant}.flushes")
+        _registry.inc(f"serve.tenant.{stream.tenant}.nodes",
+                      len(program.instrs))
+    work.leaf_vals = None
+    for arr, expr, val in zip(work.roots, work.root_exprs, outs):
+        if arr._expr is expr:
+            arr._set_expr(Const(val))
+    span["segments"] = 0
+    span["compile_s"] = 0.0
+    span["execute_s"] = 0.0
+    span["cache"] = "memo"
+    span["memo_hit"] = True
+    span["out_bytes"] = sum(_nbytes(v) for v in outs)
+    span["wall_s"] = round(time.perf_counter() - work.t_flush, 6)
+    _events.emit(span)
+    _slo.observe_span(span)
+    _elastic.note_progress("flush")
+    return list(outs[len(work.roots):])
 
 
 def _flush_dispatch(work: "_FlushWork", *, coalesced: int = 0) -> list:
@@ -1520,6 +1584,19 @@ def _flush_dispatch_traced(work: "_FlushWork", *, coalesced: int = 0) -> list:
         span["queue_s"] = round(time.perf_counter() - work.enqueued_at, 6)
     if coalesced > 1:
         span["coalesced"] = coalesced
+    if (work.memo_hit is None and work.memo_plan is not None
+            and work.enqueued_at is not None):
+        # Dispatch-time re-lookup (queued work only — the sync path just
+        # looked up in prepare): a prepare-time miss may have become a
+        # hit while this work sat queued (an earlier ticket with the same
+        # canonical key executed and inserted) — this is what turns
+        # serving-batch duplicates into CSE merges.
+        try:
+            work.memo_hit = _memo.lookup(work.memo_plan)
+        except Exception:
+            pass
+    if work.memo_hit is not None:
+        return _finish_memo_hit(work)
     tags = {"tenant": stream.tenant} if stream.tenant is not None else None
     leaf_vals = work.leaf_vals
     try:
@@ -1554,6 +1631,11 @@ def _flush_dispatch_traced(work: "_FlushWork", *, coalesced: int = 0) -> list:
         _registry.inc(f"serve.tenant.{stream.tenant}.flushes")
         _registry.inc(f"serve.tenant.{stream.tenant}.nodes",
                       len(program.instrs))
+    if work.memo_plan is not None:
+        try:
+            _memo.insert(work.memo_plan, list(outs))
+        except Exception:
+            _registry.inc("memo.insert_failed")
     work.leaf_vals = None  # drop donated-buffer refs before write-back
     del leaf_vals
     for arr, expr, val in zip(roots, work.root_exprs, outs):

@@ -111,6 +111,15 @@ def slo_report() -> dict:
     return _slo.snapshot()
 
 
+def memo_report() -> dict:
+    """Result-memoization cache snapshot (core/memo.py): entry count,
+    retained bytes vs RAMBA_MEMO_BUDGET, hit/miss/insert/eviction
+    counters and the strict-mode insert rejections."""
+    from ramba_tpu.core import memo as _memo
+
+    return _memo.cache.snapshot()
+
+
 def snapshot() -> dict:
     """Everything, JSON-serializable: registry stores + the event ring.
 
@@ -133,6 +142,9 @@ def snapshot() -> dict:
     if any(slo.get("histograms", {}).values()):
         snap["slo"] = slo
     snap["elastic"] = elastic_report()
+    memo = memo_report()
+    if memo["enabled"] or memo["inserts"] or memo["hits"]:
+        snap["memo"] = memo
     return snap
 
 
@@ -209,6 +221,18 @@ def report(file=None) -> None:
             print(line, file=file)
         if perf["slow_flushes"]:
             print(f"  slow flushes: {perf['slow_flushes']}", file=file)
+    memo = memo_report()
+    if memo["enabled"] or memo["inserts"] or memo["hits"]:
+        print("-- result memo --", file=file)
+        print(
+            f"  entries={memo['entries']} bytes={memo['bytes']:,d}B"
+            f" budget={memo['budget_bytes']:,d}B"
+            f" hits={memo['hits']} misses={memo['misses']}"
+            f" hit_rate={memo['hit_rate']:.1%}"
+            f" inserts={memo['inserts']} evictions={memo['evictions']}"
+            f" rejects={memo['insert_rejects']}",
+            file=file,
+        )
     serving = serving_report()
     if serving:
         print("-- serving (per tenant) --", file=file)

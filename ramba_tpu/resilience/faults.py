@@ -55,9 +55,15 @@ beacon, so a seeded hang delays a beat), ``donate_census``
 (which does not fail the flush: it corrupts the buffer-donation mask so
 the RAMBA_VERIFY donation-hazard rule has a real violation to catch),
 ``reshard:plan`` (checked after the coherence fence agrees a reshard
-schedule, before any stage runs), and ``reshard:stage`` (checked at
+schedule, before any stage runs), ``reshard:stage`` (checked at
 the top of every reshard stage — ``reshard:stage:2`` kills a reshard
-mid-schedule, ``reshard:stage:hang:ms=500:after=1`` stalls stage 2).
+mid-schedule, ``reshard:stage:hang:ms=500:after=1`` stalls stage 2),
+and ``memo:insert`` / ``memo:hit`` (like ``donate_census``, these do
+not fail the flush: they corrupt the result-memoization certifier in
+``core/memo.py`` into admitting an impure or alias-escaping program,
+the seeded violation the RAMBA_VERIFY memo-safety rule exists to
+catch — ``memo:insert:once`` poisons one insert, ``memo:hit`` the
+lookup path of an already-poisoned entry).
 
 Site names may themselves contain colons (``reshard:plan``,
 ``reshard:stage``): the site/mode boundary in a spec is the FIRST

@@ -265,6 +265,13 @@ class CompilePipeline:
             if trace_ids:
                 ev["trace_ids"] = trace_ids
             _events.emit(ev)
+        # Batch-level CSE bookkeeping: tickets whose certified memo keys
+        # repeat within this dispatch run should share one execution —
+        # the leader executes + inserts, the followers' dispatch-time
+        # re-lookup hits (span cache == "memo").  A duplicate that still
+        # re-executed (memo full / racing eviction) is a dup exec — the
+        # serving-waste signal bench.py reports as serving_dup_execs.
+        seen_keys: set = set()
         for ticket in group:
             if isinstance(ticket, WarmTicket):
                 # Warm tasks carry a bare thunk, not prepared flush work.
@@ -279,12 +286,30 @@ class CompilePipeline:
             ticket.coalesced = n
             work = ticket.work
             work.span["async"] = True
+            plan = work.memo_plan
+            key = (plan.key if plan is not None and plan.memoizable
+                   and plan.key is not None else None)
+            is_dup = key is not None and key in seen_keys
+            if key is not None:
+                seen_keys.add(key)
             try:
                 with _fuser.stream_scope(work.stream):
                     result = _fuser._flush_dispatch(work, coalesced=n)
             except BaseException as e:  # ladder exhausted / fatal
                 self._finish(ticket, error=e)
                 continue
+            if is_dup:
+                tenant = ticket.stream.tenant
+                if work.span.get("cache") == "memo":
+                    _registry.inc("serve.cse_merged")
+                    if tenant is not None:
+                        _registry.inc(f"serve.tenant.{tenant}.cse_merged")
+                    ev = {"type": "cse_merge", "chash": plan.chash}
+                    if tenant is not None:
+                        ev["tenant"] = tenant
+                    _events.emit(ev)
+                else:
+                    _registry.inc("serve.dup_execs")
             self.dispatched += 1
             self._finish(ticket, result=result)
 

@@ -49,6 +49,8 @@ _METRIC_DIRECTION = {
     "matmul_tflops": "higher",
     "serving_flushes_per_s": "higher",
     "serving_p95_flush_ms": "lower",
+    "memo_hit_rate": "higher",          # result-cache dedup (RAMBA_MEMO)
+    "serving_dup_execs": "lower",       # duplicates that escaped batch CSE
     "observe_events_per_s": "higher",
     "observe_flush_overhead_pct": "lower",
     "observe_scrape_ms": "lower",

@@ -28,3 +28,5 @@ echo "=== leg 11: 2-process rank-skewed chaos soak (coherent recovery) ==="
 python scripts/two_process_suite.py --chaos-leg
 echo "=== leg 12: staged resharding + live mesh elasticity (2-rank round-trip, 2->1 reshape) ==="
 python scripts/two_process_suite.py --reshard-leg
+echo "=== leg 13: effect-certified result memoization (2-rank lockstep cache) ==="
+python scripts/two_process_suite.py --memo-leg

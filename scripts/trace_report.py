@@ -105,6 +105,7 @@ def report(path: str, events: list, top: int = 10, file=None) -> None:
     execute_s = sum(f.get("execute_s", 0.0) for f in flushes)
     linearize_s = sum(f.get("linearize_s", 0.0) for f in flushes)
     hits = sum(1 for f in flushes if f.get("cache") == "hit")
+    memo_hits = sum(1 for f in flushes if f.get("cache") == "memo")
     instrs = sum(f.get("instrs", 0) for f in flushes)
     leaf_b = sum(f.get("leaf_bytes", 0) for f in flushes)
     out_b = sum(f.get("out_bytes", 0) for f in flushes)
@@ -117,12 +118,25 @@ def report(path: str, events: list, top: int = 10, file=None) -> None:
         f"execute-cached {execute_s:.4f}s)",
         file=file,
     )
-    print(
+    line = (
         f"cache: {hits}/{len(flushes)} hit "
         f"({100.0 * hits / len(flushes):.0f}%)  "
-        f"instrs: {instrs}  segments: {segs}  donated bufs: {donated}",
-        file=file,
+        f"instrs: {instrs}  segments: {segs}  donated bufs: {donated}"
     )
+    if memo_hits:
+        line += f"  memo hits: {memo_hits}"
+    print(line, file=file)
+    cse = [e for e in events if e.get("type") == "cse_merge"]
+    if memo_hits or cse:
+        rejected = sum(1 for e in events
+                       if e.get("type") == "memo_insert_rejected")
+        line = (f"result memo: {memo_hits}/{len(flushes)} flushes served "
+                f"from cache ({100.0 * memo_hits / len(flushes):.0f}%)")
+        if cse:
+            line += f"  cse merges: {len(cse)}"
+        if rejected:
+            line += f"  uncertified inserts rejected: {rejected}"
+        print(line, file=file)
     peak_live = max((f.get("mem_live_bytes", 0) or 0) for f in flushes)
     peak_est = max((f.get("mem_peak_est", 0) or 0) for f in flushes)
     line = f"bytes: in {_fmt_bytes(leaf_b)}  out {_fmt_bytes(out_b)}"

@@ -107,6 +107,18 @@ asserts each rank's scrape is labeled with its own distinct
 ``rank="<r>"`` and that the shared trace_id landed in BOTH ranks'
 RAMBA_TRACE event files — the inputs ``trace_report.py --trace`` needs
 to reconstruct one request across the fleet.
+
+``--memo-leg`` runs the result-memoization acceptance leg: both ranks
+under ``RAMBA_MEMO=1`` canonicalize the same program (including its
+commutative-operand swap — ``analyze.canonicalize`` must produce the
+SAME chash for ``(a+b)*2`` and ``(b+a)*2`` on both ranks) and then
+flush it repeatedly over stable buffers.  Memo hits are rank-local
+decisions that SKIP dispatch, so the cache MUST hit in lockstep: a
+rank that replays from cache while its peer executes would mispair the
+post-flush gathers.  The runner asserts both ranks print the identical
+canonical hash, the identical hit/insert counts, the correct value,
+and that each per-rank trace carries memo-served flush spans
+(``cache == "memo"``).
 """
 
 from __future__ import annotations
@@ -209,6 +221,47 @@ assert keys, rep
 execs = sum(k['exec']['count'] for k in rep['kernels'].values())
 assert execs >= 1, rep
 print('PERF_LEG_KEYS rank=%d %s' % (rank, ','.join(keys)))
+"""
+
+
+# SPMD workload for the memo leg: each rank forms the process group,
+# canonicalizes the shared program (asserting the commutative swap
+# collapses to the same chash locally), then flushes it four times over
+# stable buffers under RAMBA_MEMO=1 — one insert, three hits.  The
+# canonical hash and the hit/insert counters are printed for the runner
+# to compare across ranks: the hash is a pure function of program
+# structure and the cache decision is deterministic given it, so any
+# skew here means the ranks would dispatch different flush sequences.
+# argv: <rank> <coordinator>.
+_MEMO_WORKLOAD = """
+import sys
+import numpy as np
+rank, coord = int(sys.argv[1]), sys.argv[2]
+from ramba_tpu.parallel import distributed
+distributed.initialize(coordinator_address=coord, num_processes=2,
+                       process_id=rank)
+import jax
+assert jax.process_count() == 2, jax.process_count()
+import ramba_tpu as rt
+from ramba_tpu import analyze
+from ramba_tpu.core import fuser, memo
+assert memo.enabled(), 'RAMBA_MEMO not armed'
+a = rt.arange(4096) / 100.0
+b = rt.arange(4096) * 0.5 + 1.0
+rt.sync()
+vals = [float(rt.sum((a + b) * 2.0)) for _ in range(4)]
+assert max(vals) == min(vals), vals
+p1, _l1, _ = fuser._prepare_program([((a + b) * 2.0)._expr])
+p2, _l2, _ = fuser._prepare_program([((b + a) * 2.0)._expr])
+c1, c2 = analyze.canonicalize(p1), analyze.canonicalize(p2)
+assert c1.chash == c2.chash, (c1.chash, c2.chash)
+an = np.arange(4096)
+exp = float(np.sum((an / 100.0 + (an * 0.5 + 1.0)) * 2.0))
+assert abs(vals[0] - exp) <= 1e-4 * abs(exp), (vals[0], exp)
+snap = memo.cache.snapshot()
+assert snap['hits'] >= 3, snap
+print('MEMO_LEG rank=%d chash=%s hits=%d inserts=%d' % (
+    rank, c1.chash, snap['hits'], snap['inserts']))
 """
 
 
@@ -1111,6 +1164,102 @@ def run_perf_leg() -> int:
     return 0 if ok else 1
 
 
+def run_memo_leg() -> int:
+    """Two ranks under RAMBA_MEMO=1; both must compute the identical
+    canonical hash and hit the result cache in LOCKSTEP (a hit skips
+    dispatch — rank-skewed hits would mispair the post-flush gathers)."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    basetemp = tempfile.mkdtemp(prefix="ramba_2proc_memo_")
+    trace_base = os.path.join(basetemp, "trace.jsonl")
+    budget = float(os.environ.get("RAMBA_TEST_PROCS_TIMEOUT", "600"))
+
+    procs, logs = [], []
+    for rank in range(2):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        for k in ("RAMBA_TEST_PROCS", "RAMBA_TEST_PROC_ID",
+                  "RAMBA_TEST_COORD", "RAMBA_TEST_SHARED_TMP",
+                  "RAMBA_PROFILE_DIR", "RAMBA_FAULTS", "RAMBA_HBM_BUDGET",
+                  "RAMBA_MEMO_BUDGET"):
+            env.pop(k, None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["RAMBA_MEMO"] = "1"
+        env["RAMBA_TRACE"] = trace_base
+        log = open(os.path.join(basetemp, f"rank{rank}.log"), "w")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _MEMO_WORKLOAD, str(rank),
+             f"localhost:{port}"],
+            env=env, stdout=log, stderr=subprocess.STDOUT, cwd=REPO,
+        ))
+
+    deadline = time.time() + budget
+    rcs = [None, None]
+    try:
+        for i, p in enumerate(procs):
+            left = max(5.0, deadline - time.time())
+            try:
+                rcs[i] = p.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                rcs[i] = -9
+    finally:
+        for log in logs:
+            log.close()
+
+    ok = all(rc == 0 for rc in rcs)
+
+    # The canonical hash is a pure function of program structure and the
+    # hit/insert counts a deterministic function of the flush sequence:
+    # both markers must be IDENTICAL across ranks.
+    markers = [None, None]
+    for rank in range(2):
+        path = os.path.join(basetemp, f"rank{rank}.log")
+        with open(path) as f:
+            tail = f.read().splitlines()
+        for line in tail:
+            if line.startswith(f"MEMO_LEG rank={rank} "):
+                markers[rank] = line.split(" ", 2)[2]
+        if markers[rank] is None:
+            ok = False
+        print(f"--- memo leg rank {rank} rc={rcs[rank]} ({path}) ---")
+        print("\n".join(tail[-(4 if ok else 40):]))
+    if ok and markers[0] != markers[1]:
+        print(f"memo leg: FAIL (rank skew: r0={markers[0]} "
+              f"r1={markers[1]})")
+        ok = False
+    elif ok:
+        print(f"memo leg: lockstep across ranks ({markers[0]})")
+
+    # Each per-rank trace must carry memo-served flush spans: the hits
+    # were real short-circuits, visible to trace_report's memo line.
+    import json
+
+    for rank in range(2):
+        path = f"{trace_base}.rank{rank}"
+        try:
+            with open(path) as f:
+                evs = [json.loads(ln) for ln in f if ln.strip()]
+            n_memo = sum(1 for e in evs if e.get("type") == "flush"
+                         and e.get("cache") == "memo")
+            print(f"memo leg rank {rank}: {len(evs)} events, "
+                  f"{n_memo} memo-served flushes")
+            if n_memo < 3:
+                print(f"memo leg rank {rank}: FAIL (memo spans={n_memo})")
+                ok = False
+        except (OSError, ValueError) as e:
+            print(f"memo leg rank {rank}: FAIL ({e})")
+            ok = False
+
+    print(f"two-process memo leg: {'OK' if ok else 'FAIL'}")
+    if ok:
+        shutil.rmtree(basetemp, ignore_errors=True)
+    return 0 if ok else 1
+
+
 def run_autotune_leg() -> int:
     """Two ranks under RAMBA_AUTOTUNE=race; both must latch the SAME
     backend per kernel fingerprint (selection is ledger-count-driven and
@@ -1620,6 +1769,8 @@ def main() -> int:
         return run_telemetry_leg()
     if "--autotune-leg" in sys.argv[1:]:
         return run_autotune_leg()
+    if "--memo-leg" in sys.argv[1:]:
+        return run_memo_leg()
     pytest_args = sys.argv[1:] or ["tests/"]
     with socket.socket() as s:
         s.bind(("localhost", 0))

@@ -50,10 +50,14 @@ def _isolate(monkeypatch):
     """Start each test with an empty pending set and no fault plan; keep
     the suite's outer RAMBA_VERIFY (the strict CI leg) from leaking into
     tests that exercise a specific mode by letting them monkeypatch it."""
+    from ramba_tpu.core import memo
+
     fuser.flush()
     faults.configure(None)
+    memo.reset()
     yield
     faults.reset()
+    memo.reset()
 
 
 def _findings(fs, rule, severity=None):
@@ -471,3 +475,326 @@ class TestOfflineLint:
         evs = alint.load_events(alint.discover(path)[0])
         assert any(e.get("type") == "program" for e in evs)
         assert alint.lint_events(evs) == []
+
+
+# ---------------------------------------------------------------------------
+# effect classification (the memoization certifier's front half)
+# ---------------------------------------------------------------------------
+
+
+class TestEffects:
+    def test_pure_program(self):
+        a = rt.asarray(np.ones((4, 4)))
+        b = (a + 1.0) * a
+        prog, _leaves, _ = fuser._prepare_program([b._expr])
+        rep = analyze.classify_program(prog)
+        assert rep.program_class == "pure"
+        assert rep.memoizable and rep.reason == ""
+        assert rep.host_instrs == () and rep.alias_outs == ()
+        fuser.flush()
+
+    def test_rng_program_is_memoizable(self):
+        rt.random.seed(0)
+        r = rt.random.random((4,)) + 1.0
+        prog, _leaves, _ = fuser._prepare_program([r._expr])
+        rep = analyze.classify_program(prog)
+        assert rep.program_class == "rng"
+        assert rep.rng_instrs  # the draw itself
+        assert rep.memoizable  # key is an operand, so replay is sound
+        fuser.flush()
+
+    def test_closure_static_is_host_effecting(self):
+        ff = rt.fromfunction(lambda i, j: i + j, (3, 3))
+        prog, _leaves, _ = fuser._prepare_program([(ff + 1.0)._expr])
+        rep = analyze.classify_program(prog)
+        assert rep.program_class == "host"
+        assert not rep.memoizable
+        assert "host-effecting" in rep.reason
+        fuser.flush()
+
+    def test_alias_escaping_output_vetoes(self):
+        # out slot 0 < n_leaves: the program returns an input unchanged
+        prog = fuser._Program((("negative", None, (0,)),), 1, ("C",), (0, 1))
+        rep = analyze.classify_program(prog)
+        assert rep.alias_outs == (0,)
+        assert not rep.memoizable and "aliases a program input" in rep.reason
+
+    def test_donation_vetoes(self):
+        prog = fuser._Program((("negative", None, (0,)),), 1, ("C",), (1,))
+        rep = analyze.classify_program(prog, donate=(0,))
+        assert rep.donating
+        assert not rep.memoizable and "donates" in rep.reason
+
+    def test_static_token_folds_values_not_identities(self):
+        assert analyze.static_token(("add", 3, 2.5)) is not None
+        assert analyze.static_token(np.dtype("float32")) == (
+            "dtype", "float32")
+        assert analyze.static_token(np.float32(2.0)) is not None
+        # identity-hashed: a closure's repr embeds its address
+        assert analyze.static_token(repr(lambda x: x)) is None
+        assert analyze.static_token((lambda x: x,)) is None
+
+
+# ---------------------------------------------------------------------------
+# canonical subgraph hashing
+# ---------------------------------------------------------------------------
+
+
+class TestCanon:
+    def _chash(self, expr):
+        prog, _leaves, _ = fuser._prepare_program([expr])
+        return analyze.canonicalize(prog).chash
+
+    def test_commutative_operand_order_is_normalized(self):
+        a = rt.asarray(np.arange(6.0))
+        b = rt.asarray(np.ones(6))
+        h_ab = self._chash(((a + b) * 2.0)._expr)
+        h_ba = self._chash(((b + a) * 2.0)._expr)
+        h_sub = self._chash(((a - b) * 2.0)._expr)
+        assert h_ab == h_ba            # add commutes
+        assert h_ab != h_sub           # subtract does not
+        fuser.flush()
+
+    def test_alpha_renaming_across_different_leaves(self):
+        # the same shape of computation over DIFFERENT arrays must hash
+        # identically — slots are alpha-renamed, not identity-keyed
+        a = rt.asarray(np.arange(6.0))
+        b = rt.asarray(np.ones(6))
+        c = rt.asarray(np.arange(6.0) * 3)
+        assert (self._chash(((a + b) * 2.0)._expr)
+                == self._chash(((c + b) * 2.0)._expr))
+        fuser.flush()
+
+    def test_closure_static_is_not_canonical(self):
+        ff = rt.fromfunction(lambda i, j: i * j, (3, 3))
+        prog, _leaves, _ = fuser._prepare_program([(ff + 1.0)._expr])
+        assert analyze.try_canonicalize(prog) is None
+        with pytest.raises(analyze.NotCanonical):
+            analyze.canonicalize(prog)
+        fuser.flush()
+
+    def test_dead_instructions_do_not_constrain(self):
+        # a dead instr with an untokenizable static must not block
+        # canonicalization — dead code is not part of the semantics
+        prog = fuser._Program(
+            (("apply", (lambda x: x,), (0,)), ("negative", None, (0,))),
+            1, ("C",), (2,),
+        )
+        form = analyze.try_canonicalize(prog)
+        assert form is not None
+        live = fuser._Program((("negative", None, (0,)),), 1, ("C",), (1,))
+        assert form.chash == analyze.canonicalize(live).chash
+
+    def test_stability_across_process_values(self):
+        # the hash is derived from structure only — it must be a pure
+        # function of the canonical form string (cross-session stable)
+        a = rt.asarray(np.ones(4))
+        prog, _leaves, _ = fuser._prepare_program([(a * 2.0)._expr])
+        f1 = analyze.canonicalize(prog)
+        f2 = analyze.canonicalize(prog)
+        assert f1.chash == f2.chash and f1.form == f2.form
+        assert f1.leaf_order == f2.leaf_order
+        fuser.flush()
+
+
+# ---------------------------------------------------------------------------
+# dead entropy (graph-hygiene extension)
+# ---------------------------------------------------------------------------
+
+
+class TestDeadEntropy:
+    def test_dead_rng_draw_flagged(self):
+        # instr0: an RNG draw nothing consumes; instr1 feeds the output
+        prog = fuser._Program(
+            (("random", ("uniform", (4,), "float32", None), (0,)),
+             ("negative", None, (0,))),
+            1, ("C",), (2,),
+        )
+        view = averifier.ProgramView(program=prog, key_registry={},
+                                     canon_registry={})
+        fs = arules.RULES["graph-hygiene"](view)
+        dead_entropy = [f for f in fs if "dead-entropy" in f.message]
+        assert dead_entropy and dead_entropy[0].severity == "warning"
+        assert dead_entropy[0].node == "instr0:random"
+
+    def test_live_rng_draw_not_flagged(self):
+        prog = fuser._Program(
+            (("random", ("uniform", (4,), "float32", None), (0,)),),
+            1, ("C",), (1,),
+        )
+        view = averifier.ProgramView(program=prog, key_registry={},
+                                     canon_registry={})
+        fs = arules.RULES["graph-hygiene"](view)
+        assert not any("dead-entropy" in f.message for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# canonical-hash collision detector
+# ---------------------------------------------------------------------------
+
+
+class TestCanonCollision:
+    def _program(self):
+        a = rt.asarray(np.ones((4, 4), np.float32))
+        prog, _leaves, _ = fuser._prepare_program([(a * 2.0)._expr])
+        fuser.flush()
+        return prog
+
+    def test_seeded_collision_is_flagged(self):
+        # Seed the registry with the program's hash bound to a DIFFERENT
+        # form — exactly what a truncated-digest collision (or a forged
+        # key) would look like.
+        prog = self._program()
+        form = analyze.canonicalize(prog)
+        reg = {form.chash: "some-other-canonical-form"}
+        fs = arules.check_canon_collision(prog, registry=reg)
+        assert len(fs) == 1
+        assert fs[0].severity == "error" and "collision" in fs[0].message
+
+    def test_repeat_observation_is_clean(self):
+        prog = self._program()
+        reg = {}
+        assert arules.check_canon_collision(prog, registry=reg) == []
+        assert arules.check_canon_collision(prog, registry=reg) == []
+        assert len(reg) == 1
+
+    def test_uncanonical_program_is_skipped(self):
+        prog = fuser._Program(
+            (("apply", (lambda x: x,), (0,)),), 1, ("C",), (1,))
+        assert arules.check_canon_collision(prog, registry={}) == []
+
+
+# ---------------------------------------------------------------------------
+# memo-safety: the seeded-certifier-corruption fixture
+# ---------------------------------------------------------------------------
+
+
+class TestMemoSafety:
+    def test_rule_flags_donating_plan(self):
+        import types
+
+        prog = fuser._Program((("negative", None, (0,)),), 1, ("C",), (1,))
+        plan = types.SimpleNamespace(memoizable=True, chash="x", form="y")
+        view = averifier.ProgramView(program=prog, donate=(0,),
+                                     memo_plan=plan)
+        fs = arules.RULES["memo-safety"](view)
+        assert any(f.severity == "error" and "donates" in f.message
+                   for f in fs)
+
+    def test_rule_flags_alias_escape_and_host(self):
+        import types
+
+        prog = fuser._Program(
+            (("apply", (lambda x: x,), (0,)),), 1, ("C",), (0, 1))
+        plan = types.SimpleNamespace(memoizable=True, chash="x", form="y")
+        view = averifier.ProgramView(program=prog, memo_plan=plan)
+        fs = arules.RULES["memo-safety"](view)
+        assert any("host-effecting" in f.message for f in fs)
+        assert any("aliases a program input" in f.message for f in fs)
+
+    def test_no_plan_is_vacuously_safe(self):
+        prog = fuser._Program((("negative", None, (0,)),), 1, ("C",), (1,))
+        view = averifier.ProgramView(program=prog, donate=(0,))
+        assert arules.RULES["memo-safety"](view) == []
+
+    def test_fault_seeded_violation_warn_mode(self, monkeypatch):
+        # The memo:insert fault corrupts the certifier into admitting a
+        # donating program (donation seeded by donate_census); warn mode
+        # must flag it, route the flush down the ladder, and never let
+        # the poisoned plan touch the cache.
+        from ramba_tpu.core import memo
+
+        monkeypatch.setenv("RAMBA_MEMO", "1")
+        monkeypatch.setenv("RAMBA_VERIFY", "warn")
+        monkeypatch.setenv("RAMBA_VERIFY_RULES", "memo-safety")
+        a = rt.asarray(np.ones((64, 64)))
+        b = a + 1.0
+        faults.configure("memo:insert:always,donate_census:always")
+        try:
+            fuser.flush()
+        finally:
+            faults.configure(None)
+        ev = events.last(5, type="finding")
+        assert any(e["rule"] == "memo-safety" for e in ev)
+        span = events.last(1, type="flush")[-1]
+        assert span.get("verify_routed") is True
+        assert len(memo.cache) == 0  # poisoned plan never cached
+        np.testing.assert_array_equal(np.asarray(b), 2.0)
+        np.testing.assert_array_equal(np.asarray(a), 1.0)
+
+    def test_fault_seeded_violation_strict_raises(self, monkeypatch):
+        monkeypatch.setenv("RAMBA_MEMO", "1")
+        monkeypatch.setenv("RAMBA_VERIFY", "strict")
+        monkeypatch.setenv("RAMBA_VERIFY_RULES", "memo-safety")
+        a = rt.asarray(np.ones((64, 64)))
+        b = a + 1.0
+        faults.configure("memo:insert:always,donate_census:always")
+        try:
+            with pytest.raises(ProgramVerificationError) as ei:
+                fuser.flush()
+        finally:
+            faults.configure(None)
+        errs = _findings(ei.value.findings, "memo-safety", "error")
+        assert errs, ei.value.findings
+        # nothing executed: both arrays still usable afterwards
+        monkeypatch.setenv("RAMBA_VERIFY", "0")
+        np.testing.assert_array_equal(np.asarray(b), 2.0)
+        np.testing.assert_array_equal(np.asarray(a), 1.0)
+
+    def test_strict_insert_backstop_without_the_rule(self, monkeypatch):
+        # Even with the rule filtered out, strict mode's insert-time
+        # backstop refuses the uncertified plan.
+        from ramba_tpu.core import memo
+        from ramba_tpu.observe import registry
+
+        monkeypatch.setenv("RAMBA_MEMO", "1")
+        monkeypatch.setenv("RAMBA_VERIFY", "strict")
+        monkeypatch.setenv("RAMBA_VERIFY_SKIP",
+                           "memo-safety,donation-hazard")
+        rejected0 = registry.get("memo.insert_rejected")
+        a = rt.asarray(np.ones((64, 64)))
+        b = a + 1.0
+        faults.configure("memo:insert:always,donate_census:always")
+        try:
+            fuser.flush()
+        finally:
+            faults.configure(None)
+        assert registry.get("memo.insert_rejected") == rejected0 + 1
+        assert len(memo.cache) == 0
+        np.testing.assert_array_equal(np.asarray(b), 2.0)
+        del a
+
+
+# ---------------------------------------------------------------------------
+# ramba-lint --memo-audit
+# ---------------------------------------------------------------------------
+
+
+class TestMemoAudit:
+    def test_audit_groups_and_rates(self, tmp_path, capsys):
+        ev = _program_event()
+        flush = {"type": "flush", "label": "prog_test", "out_bytes": 128}
+        p = tmp_path / "t.jsonl"
+        p.write_text("\n".join(
+            [json.dumps(ev)] * 3 + [json.dumps(flush)] * 3) + "\n")
+        assert alint.main(["--memo-audit", str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "would-be hits: 2" in out
+        assert "memoizable" in out
+
+    def test_audit_json(self, tmp_path, capsys):
+        p = tmp_path / "t.jsonl"
+        p.write_text(json.dumps(_program_event()) + "\n")
+        assert alint.main(["--memo-audit", "--json", str(p)]) == 0
+        rec = json.loads(capsys.readouterr().out.strip())
+        assert rec["programs"] == 1 and rec["would_hits"] == 0
+        assert rec["top"][0]["memoizable"] is True
+
+    def test_audit_flags_uncacheable(self, tmp_path, capsys):
+        # a donating recorded program is grouped but marked uncacheable
+        ev = _program_event(donate=[0])
+        p = tmp_path / "t.jsonl"
+        p.write_text((json.dumps(ev) + "\n") * 2)
+        assert alint.main(["--memo-audit", str(p)]) == 0
+        out = capsys.readouterr().out
+        assert "uncacheable" in out and "would-be hits: 0" in out
