@@ -378,6 +378,107 @@ def _bench_serving(rt, platform):
     }
 
 
+def _bench_serving_overload(rt, platform):
+    """Overload-control section: the serving plane at ~3x sustainable
+    load (ramba_tpu/serve/overload.py).  Each session carries a deadline
+    sized so roughly one third of the offered burst can finish in
+    budget; the rest must be shed BEFORE compile/dispatch.  Three
+    numbers feed scripts/perf_diff.py: ``goodput_flushes_per_s``
+    (admitted work completed per second — shedding must not tax the
+    survivors), ``p95_admitted_ms`` (tail latency of the admitted set,
+    which the deadline keeps inside the SLO no matter the backlog), and
+    ``shed_fail_fast_ms`` (p95 wall of one classified rejection on the
+    admission fast path — overload answers in O(ms), it never queues a
+    caller to tell them no)."""
+    import threading
+
+    from ramba_tpu import serve
+    from ramba_tpu.serve import overload
+
+    n_sessions = 3
+    per_session = 16 if platform != "cpu" else 8
+    n = 262_144 if platform != "cpu" else 16_384
+
+    # calibrate one warm flush so the deadline tracks the machine
+    with serve.Session(tenant="ovwarm") as s:
+        est = []
+        for _ in range(3):
+            a = rt.arange(n) * 2.0 + 1.0
+            t0 = time.perf_counter()
+            s.flush(wait=True)
+            est.append(time.perf_counter() - t0)
+            del a
+    est_s = sorted(est)[1]
+    # offered = n_sessions * per_session flushes; the single dispatch
+    # worker serves them sequentially, so a budget of per_session
+    # service times admits ~1/3 of the burst: a 3x overload soak
+    deadline_ms = max(50.0, est_s * per_session * 1e3)
+
+    lat_ok, sheds, errs = [], [], []
+    lock = threading.Lock()
+
+    def worker(i):
+        try:
+            with serve.Session(tenant=f"ov{i}",
+                               deadline_ms=deadline_ms) as s:
+                tickets = []
+                arrs = []
+                for _ in range(per_session):
+                    arrs.append(rt.arange(n) * 2.0 + float(i))
+                    tickets.append((time.perf_counter(), s.flush()))
+                for t0, t in tickets:
+                    try:
+                        t.wait(timeout=600)
+                        with lock:
+                            lat_ok.append(time.perf_counter() - t0)
+                    except overload.OverloadError as e:
+                        with lock:
+                            sheds.append(e.shed_classification)
+                del arrs
+                s.close(drain=False)
+        except Exception as e:  # noqa: BLE001
+            errs.append(repr(e)[:200])
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_sessions)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    # fail-fast wall of the classified rejection path: force red
+    # brownout (backlog pinned at the depth cap) and time the refusal
+    reject = []
+    for _ in range(50):
+        r0 = time.perf_counter()
+        try:
+            overload.admit_submit(tenant="ovfast", priority=False,
+                                  queue_depth=overload.queue_depth_cap())
+        except overload.OverloadError:
+            pass
+        reject.append(time.perf_counter() - r0)
+    serve.shutdown()  # also resets brownout/breaker state
+    if errs:
+        raise RuntimeError("; ".join(errs[:3]))
+    lat_ok.sort()
+    reject.sort()
+    offered = n_sessions * per_session
+    out = {
+        "goodput_flushes_per_s": round(len(lat_ok) / wall, 1),
+        "shed_fail_fast_ms": round(
+            reject[min(len(reject) - 1, int(0.95 * len(reject)))] * 1e3, 3),
+        "serving_overload_offered": offered,
+        "serving_overload_shed": len(sheds),
+        "serving_overload_deadline_ms": round(deadline_ms, 1),
+    }
+    if lat_ok:
+        out["p95_admitted_ms"] = round(
+            lat_ok[min(len(lat_ok) - 1, int(0.95 * len(lat_ok)))] * 1e3, 2)
+    return out
+
+
 def _bench_memo(rt, platform):
     """Result-memoization section (core/memo.py, RAMBA_MEMO).  Two
     numbers feed scripts/perf_diff.py: ``memo_hit_rate`` (fraction of
@@ -831,6 +932,12 @@ def main():
             out.update(_bench_serving(rt, platform))
         except Exception:  # noqa: BLE001
             out["serving_error"] = traceback.format_exc(limit=2)[-300:]
+
+        try:
+            out.update(_bench_serving_overload(rt, platform))
+        except Exception:  # noqa: BLE001
+            out["serving_overload_error"] = (
+                traceback.format_exc(limit=2)[-300:])
 
         try:
             out.update(_bench_memo(rt, platform))

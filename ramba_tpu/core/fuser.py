@@ -152,6 +152,7 @@ class FlushStream:
     __slots__ = ("stream_id", "name", "tenant", "max_pending_ops",
                  "quota_bytes", "on_threshold", "inflight", "stats",
                  "nodes_since_flush", "trace_id", "root_span",
+                 "deadline_ms", "priority",
                  "_pending", "_lock", "_flush_lock", "__weakref__")
 
     def __init__(self, name: Optional[str] = None,
@@ -172,6 +173,10 @@ class FlushStream:
         # span of this stream carries trace_id and chains to root_span
         self.trace_id: Optional[str] = None
         self.root_span: Optional[str] = None
+        # overload plane (serve.Session mints these too): per-flush time
+        # budget and brownout-shedding exemption — see serve/overload.py
+        self.deadline_ms: Optional[float] = None
+        self.priority = False
         # in-flight async work (objects with .wait()); serve/pipeline.py
         # maintains this so drain()/materialization can rendezvous
         self.inflight: list = []
@@ -1103,7 +1108,8 @@ def _run_host(program: _Program, leaf_vals, span: Optional[dict]):
 def _execute_resilient(program: _Program, leaf_vals, donate_key: tuple,
                        span: Optional[dict], skip_fused: bool = False,
                        route_chunked: bool = False,
-                       tags: Optional[dict] = None):
+                       tags: Optional[dict] = None,
+                       deadline=None):
     """Run the program down the degradation ladder (see
     ``resilience.degrade``): fused → split → chunked → eager → host.
     Returns ``(outs, rung_name)``; rung_name is "fused" on the healthy
@@ -1123,7 +1129,13 @@ def _execute_resilient(program: _Program, leaf_vals, donate_key: tuple,
     bounds the chunked peak.
 
     ``tags`` (e.g. ``{"tenant": ...}``) ride on every degrade event the
-    ladder emits so the degradation timeline attributes to a tenant."""
+    ladder emits so the degradation timeline attributes to a tenant.
+
+    ``deadline`` (a ``serve.overload.Deadline``) makes the ladder
+    budget-aware: rungs whose rolling p50 cannot fit the remaining
+    budget are pruned (single-controller; rank-local windows must not
+    skew an SPMD ladder), every rung attempt re-checks expiry, and the
+    elastic watchdog clamps to ``min(watchdog, remaining)``."""
     rungs = []
     if not skip_fused and not route_chunked:
         rungs.append(
@@ -1158,15 +1170,34 @@ def _execute_resilient(program: _Program, leaf_vals, donate_key: tuple,
                 return False
         return True
 
+    # Deadline-aware pruning: drop rungs whose rolling p50 cannot fit
+    # the remaining budget (lazy import — serve imports this module).
+    if deadline is not None:
+        from ramba_tpu.serve import overload as _overload
+
+        label = span.get("label", "?") if span else "?"
+        tenant = tags.get("tenant") if tags else None
+        rungs = _overload.prune_rungs(rungs, deadline, label,
+                                      tenant=tenant)
+
     # Elastic watchdog: every rung attempt checks the "dispatch" fault
     # site (so RAMBA_FAULTS='dispatch:hang:ms=...' can seed a stall) and,
     # when RAMBA_WATCHDOG_S is armed, runs under a deadline — a hang
     # becomes a degrade-classified RankStallError, which the ladder
     # treats like any other failed rung instead of blocking forever.
+    # With a request deadline, the per-attempt budget is clamped to
+    # min(watchdog, remaining) so one slow rung cannot eat the whole
+    # request budget before the ladder can try a cheaper rung.
     wd = _elastic.watchdog_seconds()
 
     def _guard(rung_name: str, thunk):
         def attempt():
+            if deadline is not None:
+                from ramba_tpu.serve import overload as _overload
+
+                _overload.check_expired(
+                    deadline, span.get("label", "?") if span else "?",
+                    tenant=tags.get("tenant") if tags else None)
             _faults.check("dispatch", rung=rung_name)
             if _elastic.cancelled():
                 # the watchdog gave up on this attempt while the fault
@@ -1176,10 +1207,24 @@ def _execute_resilient(program: _Program, leaf_vals, donate_key: tuple,
                     f"abandoned {rung_name} attempt after watchdog stall")
             return thunk()
 
-        if wd is None:
-            return attempt
-        return lambda: _elastic.with_deadline("dispatch", attempt,
-                                              timeout_s=wd)
+        if deadline is None:
+            if wd is None:
+                return attempt
+            return lambda: _elastic.with_deadline("dispatch", attempt,
+                                                  timeout_s=wd)
+
+        def guarded():
+            # clamp at attempt time — the remaining budget has shrunk
+            # by however long the earlier rungs ran
+            from ramba_tpu.serve import overload as _overload
+
+            eff = _overload.clamp_watchdog(wd, deadline)
+            if eff is None:
+                return attempt()
+            return _elastic.with_deadline("dispatch", attempt,
+                                          timeout_s=eff)
+
+        return guarded
 
     rungs = [(name, _guard(name, fn)) for name, fn in rungs]
 
@@ -1268,7 +1313,7 @@ class _FlushWork:
                  "leaves", "vexprs", "leaf_vals", "donate_key", "span",
                  "label", "fingerprint", "skip_fused", "pins", "flight",
                  "t_flush", "detached", "enqueued_at", "memo_plan",
-                 "memo_hit")
+                 "memo_hit", "deadline", "is_abandoned")
 
     def __init__(self, stream, roots, extra_n):
         self.stream = stream
@@ -1293,6 +1338,11 @@ class _FlushWork:
         # cached output values when a lookup already hit
         self.memo_plan = None
         self.memo_hit = None
+        # overload plane (serve/overload.py): the request's time budget,
+        # and a pipeline-installed probe for ticket abandonment (late
+        # completions discard instead of writing back)
+        self.deadline = None
+        self.is_abandoned = None
 
 
 def _gather_leaf_vals(leaves):
@@ -1375,6 +1425,19 @@ def _release(work: "_FlushWork") -> None:
     work.pins = ()
     _flight_decref(work.flight)
     work.flight = ()
+
+
+def _flush_discard(work: "_FlushWork") -> None:
+    """Soft-discard prepared work that was shed before dispatch
+    (overload plane: queue-full unwind, abandoned-ticket drop, shed
+    verdict).  Unlike :func:`_quarantine` this is not a failure — no
+    flush_error event, no quarantine counters: the roots just leave the
+    pending set with their lazy graphs intact, so each array self-heals
+    on next touch via the per-array re-flush path.  Pins and flight
+    refs are released so the leaves stay donate-eligible."""
+    for arr in work.roots:
+        unregister_pending(arr)  # no-op when the work was detached
+    _release(work)
 
 
 def _flush_prepare(stream: FlushStream, roots: list,
@@ -1501,6 +1564,15 @@ def _flush_prepare(stream: FlushStream, roots: list,
             work.memo_hit = _memo.lookup(work.memo_plan)
         except Exception:
             work.memo_hit = None
+    # Mint the request deadline (serve/overload.py) at prepare time so
+    # the budget clock covers queueing.  Lazy import (serve imports this
+    # module); gated so the common no-deadline path never pays it.
+    if stream.deadline_ms is not None or os.environ.get("RAMBA_DEADLINE_MS"):
+        from ramba_tpu.serve import overload as _overload
+
+        work.deadline = _overload.mint_deadline(stream.deadline_ms)
+        if work.deadline is not None:
+            span["deadline_ms"] = work.deadline.budget_ms
     return work
 
 
@@ -1584,6 +1656,22 @@ def _flush_dispatch_traced(work: "_FlushWork", *, coalesced: int = 0) -> list:
         span["queue_s"] = round(time.perf_counter() - work.enqueued_at, 6)
     if coalesced > 1:
         span["coalesced"] = coalesced
+    # Overload shed verdict — before admission, compile, and execution,
+    # so a shed costs microseconds.  Epoch-agreed across ranks when
+    # coherence is engaged (all ranks shed the identical request set).
+    # A shed is a soft discard, not a failure: no quarantine, no
+    # flush_error — the roots keep their graphs and self-heal on touch.
+    if work.deadline is not None or work.enqueued_at is not None:
+        from ramba_tpu.serve import overload as _overload
+
+        try:
+            _overload.dispatch_verdict(
+                deadline=work.deadline, enqueued_at=work.enqueued_at,
+                tenant=stream.tenant,
+                priority=getattr(stream, "priority", False), label=label)
+        except _overload.OverloadError:
+            _flush_discard(work)
+            raise
     if (work.memo_hit is None and work.memo_plan is not None
             and work.enqueued_at is not None):
         # Dispatch-time re-lookup (queued work only — the sync path just
@@ -1605,14 +1693,35 @@ def _flush_dispatch_traced(work: "_FlushWork", *, coalesced: int = 0) -> list:
         route_chunked = _memory.admit(program, leaf_vals, work.donate_key,
                                       span, tenant=stream.tenant,
                                       quota=stream.quota_bytes)
+        # Hedged dispatch: when RAMBA_HEDGE_FACTOR is set and the program
+        # is effect-certified pure with no donation, a dispatch running
+        # past factor x its rolling p95 races a second attempt; the first
+        # result wins and the loser is cancel-flagged.  Gated on the env
+        # var so the common path never imports the overload plane here.
+        hedge_s = None
+        if os.environ.get("RAMBA_HEDGE_FACTOR") and not work.skip_fused:
+            from ramba_tpu.serve import overload as _overload
+
+            hedge_s = _overload.hedge_threshold(label, program,
+                                                work.donate_key)
         with _profile.annotation("ramba_flush:" + label):
             with warnings.catch_warnings():
                 warnings.filterwarnings("ignore", message=".*[Dd]onat.*")
-                outs, rung = _execute_resilient(program, leaf_vals,
-                                                work.donate_key, span,
-                                                skip_fused=work.skip_fused,
-                                                route_chunked=route_chunked,
-                                                tags=tags)
+                if hedge_s is not None:
+                    outs, rung = _overload.run_hedged(
+                        lambda hspan: _execute_resilient(
+                            program, leaf_vals, work.donate_key, hspan,
+                            skip_fused=work.skip_fused,
+                            route_chunked=route_chunked, tags=tags,
+                            deadline=work.deadline),
+                        hedge_s, span=span, label=label,
+                        tenant=stream.tenant)
+                else:
+                    outs, rung = _execute_resilient(
+                        program, leaf_vals, work.donate_key, span,
+                        skip_fused=work.skip_fused,
+                        route_chunked=route_chunked, tags=tags,
+                        deadline=work.deadline)
     except Exception as e:
         _quarantine(work, e)
         raise
@@ -1638,12 +1747,22 @@ def _flush_dispatch_traced(work: "_FlushWork", *, coalesced: int = 0) -> list:
             _registry.inc("memo.insert_failed")
     work.leaf_vals = None  # drop donated-buffer refs before write-back
     del leaf_vals
-    for arr, expr, val in zip(roots, work.root_exprs, outs):
-        # Async only: skip write-back if the user re-assigned the array's
-        # expression while this flush was in flight — their newer graph
-        # wins (it still references this one's nodes and will recompute).
-        if arr._expr is expr:
-            arr._set_expr(Const(val))
+    if (work.is_abandoned is not None and work.is_abandoned()
+            and not _coherence.engaged()):
+        # The caller abandoned the ticket while this dispatch ran: a
+        # late completion must not write results back into a stream
+        # nobody is reading.  The arrays keep their lazy graphs and
+        # self-heal on next touch.  Single-controller only — under SPMD
+        # write-back skew would diverge the next traced program.
+        _registry.inc("serve.abandoned_late")
+    else:
+        for arr, expr, val in zip(roots, work.root_exprs, outs):
+            # Async only: skip write-back if the user re-assigned the
+            # array's expression while this flush was in flight — their
+            # newer graph wins (it still references this one's nodes and
+            # will recompute).
+            if arr._expr is expr:
+                arr._set_expr(Const(val))
     calls = span["calls"]
     span["segments"] = len(calls) - 1 if len(calls) > 1 else 0
     span["compile_s"] = round(

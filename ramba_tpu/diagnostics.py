@@ -89,6 +89,15 @@ def serving_report() -> dict:
     return _serve.tenant_report()
 
 
+def overload_report() -> dict:
+    """Overload-control rollup (serve/overload.py): brownout state and
+    transition history, per-tenant circuit-breaker states/trips,
+    shed/hedge counters, CoDel drops, deadline rung skips."""
+    from ramba_tpu.serve import overload as _overload
+
+    return _overload.report()
+
+
 def elastic_report() -> dict:
     """Job-lifecycle rollup (resilience.elastic): watchdog arming,
     heartbeat liveness, stall / checkpoint / drain / resume counts."""
@@ -142,6 +151,10 @@ def snapshot() -> dict:
     if any(slo.get("histograms", {}).values()):
         snap["slo"] = slo
     snap["elastic"] = elastic_report()
+    ov = overload_report()
+    if (ov["shed_total"] or ov["breakers"] or ov["hedge"]
+            or ov["brownout"]["transitions"]):
+        snap["overload"] = ov
     memo = memo_report()
     if memo["enabled"] or memo["inserts"] or memo["hits"]:
         snap["memo"] = memo
@@ -245,6 +258,32 @@ def report(file=None) -> None:
                 f" quota_rejects={row['quota_rejects']}",
                 file=file,
             )
+    ov = overload_report()
+    if (ov["shed_total"] or ov["breakers"] or ov["hedge"]
+            or ov["brownout"]["transitions"]):
+        print("-- overload control --", file=file)
+        b = ov["brownout"]
+        print(
+            f"  brownout={b['state']} (for {b['since_s']:.1f}s)"
+            f" sheds={ov['shed_total']}"
+            f" codel_drops={ov['codel_drops']}"
+            f" rung_skips={ov['deadline_rung_skips']}",
+            file=file,
+        )
+        if ov["shed"]:
+            reasons = " ".join(f"{k}={v}" for k, v in sorted(ov["shed"].items()))
+            print(f"  shed by reason: {reasons}", file=file)
+        for tenant in sorted(ov["breakers"]):
+            br = ov["breakers"][tenant]
+            print(
+                f"  breaker {tenant:<20s} state={br['state']:<9s}"
+                f" trips={br['trips']}"
+                f" recent_failures={br['recent_failures']}",
+                file=file,
+            )
+        if ov["hedge"]:
+            bits = " ".join(f"{k}={v}" for k, v in sorted(ov["hedge"].items()))
+            print(f"  hedge: {bits}", file=file)
     el = elastic_report()
     lc = lifecycle_events()
     if (el["heartbeat_running"] or el["stalls"] or el["checkpoints"]

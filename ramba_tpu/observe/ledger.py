@@ -334,6 +334,12 @@ _kernels: "dict[str, KernelEntry]" = {}
 
 # flush-program label -> rolling wall-time window (sentinel state)
 _flush_walls: "dict[str, _Rolling]" = {}
+
+# per-(label, rung) flush walls: the overload plane's deadline-aware
+# ladder asks "can the chunked rung of THIS program fit the remaining
+# budget" — a question the label-level window cannot answer once a
+# program has degraded even once (its window then mixes rung costs)
+_rung_walls: "dict[tuple, _Rolling]" = {}
 _slow_flushes = 0
 
 
@@ -508,6 +514,11 @@ def observe_flush(span: dict) -> Optional[dict]:
                 _slow_flushes += 1
                 fire_p50 = (p50, win.count)
         win.add(wall)
+        rkey = (label, span.get("degraded") or "fused")
+        rwin = _rung_walls.get(rkey)
+        if rwin is None:
+            rwin = _rung_walls[rkey] = _Rolling()
+        rwin.add(wall)
     fired = None
     if fire_p50 is not None:
         p50, samples = fire_p50
@@ -534,6 +545,28 @@ def observe_flush(span: dict) -> Optional[dict]:
             ev["tenant"] = span["tenant"]
         fired = _events.emit(ev)
     return fired
+
+
+def flush_quantile(label: str, q: float) -> Optional[float]:
+    """Rolling flush-wall quantile for ``label``, or None below the
+    slow-flush sample floor — the hedged-dispatch trigger reads p95
+    here, so hedging stays off until real history exists."""
+    with _lock:
+        win = _flush_walls.get(label)
+        if win is None or win.count < _min_samples:
+            return None
+        return win.quantile(q)
+
+
+def rung_quantile(label: str, rung: str, q: float) -> Optional[float]:
+    """Rolling flush-wall quantile for one (label, rung) pair, or None
+    below the sample floor — the deadline-aware ladder skips rungs
+    whose p50 cannot fit the remaining budget."""
+    with _lock:
+        win = _rung_walls.get((label, rung))
+        if win is None or win.count < _min_samples:
+            return None
+        return win.quantile(q)
 
 
 def snapshot() -> dict:
@@ -566,6 +599,7 @@ def reset() -> None:
     with _lock:
         _kernels.clear()
         _flush_walls.clear()
+        _rung_walls.clear()
         _fp_memo.clear()
         _slow_flushes = 0
 

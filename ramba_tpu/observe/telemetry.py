@@ -132,6 +132,10 @@ def is_incident(event: dict) -> bool:
     t = event.get("type")
     if t in FLIGHT_TRIGGERS:
         return True
+    if t == "breaker" and event.get("action") == "open":
+        # a circuit-breaker trip is the overload plane declaring a
+        # tenant unhealthy — exactly when the recent-event window matters
+        return True
     return t == "memory" and event.get("action") == "oom_evict"
 
 

@@ -63,7 +63,16 @@ not fail the flush: they corrupt the result-memoization certifier in
 ``core/memo.py`` into admitting an impure or alias-escaping program,
 the seeded violation the RAMBA_VERIFY memo-safety rule exists to
 catch — ``memo:insert:once`` poisons one insert, ``memo:hit`` the
-lookup path of an already-poisoned entry).
+lookup path of an already-poisoned entry), and the overload-plane
+sites ``serve:admit`` / ``serve:hedge`` (``serve/overload.py``):
+``serve:admit`` is checked inside every dispatch-time shed verdict —
+an injected fault there becomes a shed *proposal*, so
+``serve:admit:3:rank=1`` makes rank 1 propose shedding the first
+three flushes and the ``serve:shed`` agreement round sheds them on
+every rank (the coherent-shedding chaos leg); ``serve:hedge`` is
+checked only by the *primary* attempt of a hedged dispatch, so
+``serve:hedge:delay:ms=200`` slows the primary deterministically and
+seeds a hedge race without perturbing results.
 
 Site names may themselves contain colons (``reshard:plan``,
 ``reshard:stage``): the site/mode boundary in a spec is the FIRST
@@ -347,6 +356,13 @@ def reset() -> None:
 
 def enabled() -> bool:
     return bool(_specs)
+
+
+def configured(site: str) -> bool:
+    """Whether a spec targets ``site``.  Rank-identical under SPMD even
+    for ``rank=``-skewed specs (the plan string is shared), which is why
+    the overload plane may use it to gate an agreement round."""
+    return site in _specs
 
 
 def stats() -> Dict[str, dict]:

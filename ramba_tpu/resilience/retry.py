@@ -87,6 +87,12 @@ def classify(exc: BaseException) -> str:
     stall = getattr(exc, "stall_classification", None)
     if stall in ("retryable", "degrade", "fatal"):
         return stall
+    # Overload sheds (serve/overload.py) are deliberate drops: retrying
+    # or degrading a shed defeats the shed.  Duck-typed like stalls —
+    # critically this catches TicketAbandoned BEFORE the TimeoutError →
+    # retryable branch below.
+    if getattr(exc, "shed_classification", None) is not None:
+        return "fatal"
     if isinstance(exc, _faults.InjectedResourceExhausted):
         return "oom"
     if isinstance(exc, _faults.InjectedFault):

@@ -27,6 +27,14 @@ Environment:
   (``common.parse_bytes`` grammar, e.g. ``512m``; unset = no quota).
 * ``RAMBA_SERVE_COALESCE`` — max flushes coalesced into one dispatch
   batch (default 8; ``1`` disables coalescing).
+* Overload plane (:mod:`ramba_tpu.serve.overload`):
+  ``RAMBA_DEADLINE_MS`` (default request deadline),
+  ``RAMBA_SERVE_QUEUE_DEPTH`` (per-tenant queue cap, default 4096),
+  ``RAMBA_SERVE_SOJOURN_MS`` (CoDel sojourn target, 0 = off),
+  ``RAMBA_HEDGE_FACTOR`` (hedged dispatch, 0 = off),
+  ``RAMBA_BREAKER_THRESHOLD`` / ``RAMBA_BREAKER_WINDOW_S`` /
+  ``RAMBA_BREAKER_COOLDOWN_S`` (per-tenant circuit breakers) — see
+  docs/index.md "Overload control & deadlines".
 
 Everything a session does lands on the existing observability surface
 with a ``tenant`` tag: flush spans and degrade/flush_error/slow_flush
@@ -37,7 +45,12 @@ snapshot — ``diagnostics.report()`` renders the rollup.
 
 from __future__ import annotations
 
+from ramba_tpu.serve import overload
 from ramba_tpu.serve.fairness import RoundRobin
+from ramba_tpu.serve.overload import (CircuitOpenError,
+                                      DeadlineExceededError, OverloadError,
+                                      QueueFullError, ShedError,
+                                      TicketAbandoned, brownout_state)
 from ramba_tpu.serve.pipeline import (CompilePipeline, FlushTicket,
                                       current_pipeline, get_pipeline,
                                       shutdown)
@@ -46,7 +59,9 @@ from ramba_tpu.serve.session import Session
 __all__ = [
     "Session", "CompilePipeline", "FlushTicket", "RoundRobin",
     "current_pipeline", "get_pipeline", "shutdown", "quiesce",
-    "tenant_report",
+    "tenant_report", "overload", "OverloadError", "DeadlineExceededError",
+    "QueueFullError", "ShedError", "CircuitOpenError", "TicketAbandoned",
+    "brownout_state", "overload_report",
 ]
 
 
@@ -57,6 +72,12 @@ def quiesce() -> int:
     from ramba_tpu.resilience import elastic as _elastic
 
     return _elastic.quiesce()
+
+
+def overload_report() -> dict:
+    """Brownout/breaker/shed/hedge rollup — the data behind the
+    overload section of ``diagnostics.report()``."""
+    return overload.report()
 
 
 def tenant_report() -> dict:

@@ -12,6 +12,12 @@ The compile pipeline's queue.  Two invariants:
 * **Round-robin between tenants** — the next dispatch comes from the
   next tenant in rotation that has work, so one tenant enqueueing 10k
   flushes delays the others by at most one batch, not 10k.
+* **Bounded depth per tenant** — ``push`` rejects once a tenant's
+  backlog reaches ``RAMBA_SERVE_QUEUE_DEPTH`` (default 4096, 0
+  disables) with a classified
+  :class:`~ramba_tpu.serve.overload.QueueFullError`: backpressure
+  surfaces at submit in O(ms) instead of as an unbounded deque that
+  converts overload into universal timeout.
 """
 
 from __future__ import annotations
@@ -20,12 +26,16 @@ import threading
 from collections import OrderedDict, deque
 from typing import Callable, List, Optional
 
+from ramba_tpu.observe import events as _events
+from ramba_tpu.observe import registry as _registry
+from ramba_tpu.serve import overload as _overload
+
 
 class RoundRobin:
-    """Per-tenant FIFO queues with round-robin popping and head-only
-    fingerprint coalescing."""
+    """Per-tenant FIFO queues with round-robin popping, head-only
+    fingerprint coalescing, and a per-tenant depth cap."""
 
-    def __init__(self):
+    def __init__(self, depth_cap: Optional[int] = None):
         # tenant -> deque (insertion order gives the stable rotation base)
         self._queues: "OrderedDict[str, deque]" = OrderedDict()
         self._rotation: List[str] = []
@@ -33,15 +43,37 @@ class RoundRobin:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._closed = False
+        # None -> read RAMBA_SERVE_QUEUE_DEPTH per push (monkeypatchable)
+        self._depth_cap = depth_cap
 
     def push(self, tenant: str, item) -> None:
+        cap = self._depth_cap
+        if cap is None:
+            cap = _overload.queue_depth_cap()
         with self._cond:
             q = self._queues.get(tenant)
             if q is None:
                 q = self._queues[tenant] = deque()
                 self._rotation.append(tenant)
+            if cap and len(q) >= cap:
+                _registry.inc("serve.shed")
+                _registry.inc("serve.shed.queue_full")
+                _events.emit({"type": "shed", "reason": "queue_full",
+                              "stage": "submit", "tenant": tenant,
+                              "depth": len(q), "cap": cap})
+                raise _overload.QueueFullError(tenant, len(q), cap)
             q.append(item)
             self._cond.notify()
+
+    def depth(self, tenant: str) -> int:
+        with self._lock:
+            q = self._queues.get(tenant)
+            return len(q) if q else 0
+
+    def max_depth(self) -> int:
+        """Deepest per-tenant backlog — the brownout queue signal."""
+        with self._lock:
+            return max((len(q) for q in self._queues.values()), default=0)
 
     def __len__(self) -> int:
         with self._lock:

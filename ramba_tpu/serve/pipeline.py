@@ -39,6 +39,8 @@ from ramba_tpu.core import fuser as _fuser
 from ramba_tpu.observe import events as _events
 from ramba_tpu.observe import registry as _registry
 from ramba_tpu.observe import slo as _slo
+from ramba_tpu.resilience import coherence as _coherence
+from ramba_tpu.serve import overload as _overload
 from ramba_tpu.serve.fairness import RoundRobin
 
 
@@ -57,7 +59,7 @@ class FlushTicket:
     have raised, just later."""
 
     __slots__ = ("stream", "work", "result", "exception", "coalesced",
-                 "trace_id", "_done")
+                 "trace_id", "deadline", "abandoned", "_done")
 
     def __init__(self, stream, work=None):
         self.stream = stream
@@ -68,6 +70,8 @@ class FlushTicket:
         # the causal trace this flush belongs to (from the prepared span)
         self.trace_id: Optional[str] = (
             work.span.get("trace_id") if work is not None else None)
+        self.deadline = getattr(work, "deadline", None)
+        self.abandoned = False
         self._done = threading.Event()
         if work is None:  # nothing was pending: born finished
             self.result = []
@@ -85,9 +89,22 @@ class FlushTicket:
         self.exception = exc
         self._done.set()
 
+    def abandon(self) -> None:
+        """Give up on this ticket: a late completion discards its results
+        instead of writing them back into a stream nobody is reading
+        (the zombie-rung cancel pattern applied to tickets).  The
+        underlying arrays stay quarantine-free and self-heal on next
+        touch via the per-array re-flush path."""
+        self.abandoned = True
+
     def wait(self, timeout: Optional[float] = None):
         if not self._done.wait(timeout):
-            raise TimeoutError("flush ticket not done")
+            # the caller is walking away — mark the ticket so the
+            # dispatch worker discards instead of writing back
+            self.abandon()
+            _registry.inc("serve.abandoned")
+            raise _overload.TicketAbandoned(
+                f"flush ticket not done after {timeout}s; ticket abandoned")
         if self.exception is not None:
             raise self.exception
         return self.result
@@ -196,7 +213,18 @@ class CompilePipeline:
         run the prepare stage on THIS thread, then queue the prepared
         work for the dispatch worker.  Returns immediately with a
         ticket.  Prepare errors behave like a synchronous flush's: they
-        raise here (after quarantining the detached roots)."""
+        raise here (after quarantining the detached roots).
+
+        Overload admission runs FIRST — breaker fail-fast and
+        brownout-red shedding cost O(ms) because no prepare work has
+        happened yet; a rejected submit leaves the stream's pending
+        graph intact (nothing was detached), so the caller can retry
+        after backoff or materialize synchronously."""
+        tenant = stream.tenant or stream.name
+        _overload.admit_submit(
+            tenant=stream.tenant,
+            priority=getattr(stream, "priority", False),
+            queue_depth=self.queue.depth(tenant) if tenant else None)
         with stream._flush_lock, _fuser.stream_scope(stream):
             roots = stream._collect(detach=True)
             work = _fuser._flush_prepare(stream, roots, list(extra),
@@ -205,10 +233,21 @@ class CompilePipeline:
             return FlushTicket(stream)
         work.enqueued_at = time.perf_counter()
         ticket = FlushTicket(stream, work)
+        # late-completion probe: dispatch checks this before write-back
+        work.is_abandoned = (lambda t=ticket: t.abandoned)
         stream.inflight.append(ticket)
         stream.stats["enqueued"] += 1
         _registry.inc("serve.enqueued")
-        self.queue.push(stream.tenant or stream.name, ticket)
+        try:
+            self.queue.push(tenant, ticket)
+        except _overload.QueueFullError:
+            # unwind: the prepared work holds pins/flight refs and its
+            # roots are registered as pending — release both so the
+            # arrays self-heal on next touch instead of leaking
+            stream.inflight.remove(ticket)
+            stream.stats["enqueued"] -= 1
+            _fuser._flush_discard(work)
+            raise
         self._ensure_worker()
         return ticket
 
@@ -218,8 +257,17 @@ class CompilePipeline:
         ``_autotune`` tenant — round-robin fairness keeps it from
         starving real flushes — and never coalesces (its fingerprint is
         None).  Errors are captured on the ticket, not raised: a failed
-        warm-up must not take down the worker."""
+        warm-up must not take down the worker.
+
+        Under yellow/red brownout speculative work is the first load to
+        shed: the thunk is dropped (never run) and an already-resolved
+        ticket returned — autotune treats an unrun warm-up exactly like
+        a lost race."""
         ticket = WarmTicket(thunk, label)
+        if not _overload.allow_speculative():
+            _registry.inc("serve.warm_shed")
+            ticket._resolve([])
+            return ticket
         _registry.inc("serve.warm_enqueued")
         self.queue.push(ticket.stream.tenant, ticket)
         self._ensure_worker()
@@ -241,6 +289,15 @@ class CompilePipeline:
             _slo.observe_e2e(time.perf_counter() - work.enqueued_at,
                              tenant=ticket.stream.tenant,
                              trace_id=ticket.trace_id)
+        # Feed the tenant's circuit breaker — but never count overload
+        # sheds as failures (a shed storm tripping breakers would be a
+        # positive feedback loop), warm thunks (no tenant traffic), or
+        # the shutdown path's synthetic errors.
+        if not isinstance(ticket, WarmTicket) and not self._stopping:
+            if error is None:
+                _overload.record_outcome(ticket.stream.tenant, True)
+            elif getattr(error, "shed_classification", None) is None:
+                _overload.record_outcome(ticket.stream.tenant, False)
         if error is not None:
             ticket._fail(error)
         else:
@@ -285,6 +342,24 @@ class CompilePipeline:
                 continue
             ticket.coalesced = n
             work = ticket.work
+            # Abandoned tickets (wait() timed out) are dropped before
+            # dispatch: discard the prepared work so the arrays
+            # self-heal instead of executing a flush nobody will read.
+            # Single-controller only — under SPMD an abandonment is
+            # rank-local state, and skipping the dispatch on one rank
+            # would desync the collective schedule.
+            if ticket.abandoned and not _coherence.engaged():
+                _fuser._flush_discard(work)
+                _registry.inc("serve.abandoned_drop")
+                tenant = ticket.stream.tenant
+                ev = {"type": "shed", "reason": "abandoned",
+                      "stage": "dispatch", "label": work.label}
+                if tenant is not None:
+                    ev["tenant"] = tenant
+                _events.emit(ev)
+                self._finish(ticket, error=_overload.TicketAbandoned(
+                    "ticket abandoned by caller before dispatch"))
+                continue
             work.span["async"] = True
             plan = work.memo_plan
             key = (plan.key if plan is not None and plan.memoizable
@@ -347,9 +422,13 @@ def current_pipeline() -> Optional[CompilePipeline]:
 
 
 def shutdown() -> None:
-    """Stop the shared pipeline (tests)."""
+    """Stop the shared pipeline (tests).  Overload-plane state
+    (breakers, brownout, CoDel clocks) is per-pipeline — it resets with
+    the pipeline so one test's tripped breaker cannot shed the next
+    test's traffic."""
     global _pipeline
     with _pipeline_lock:
         p, _pipeline = _pipeline, None
     if p is not None:
         p.stop()
+    _overload.reset()

@@ -32,6 +32,17 @@ Per-session knobs:
   ``RAMBA_TPU_MAX_PENDING``).  Threshold flushes go through the async
   pipeline, so a long build loop streams work to the device instead of
   stalling on a synchronous flush.
+* ``deadline_ms`` — per-flush time budget (default
+  ``RAMBA_DEADLINE_MS``, unset = none).  Minted into a
+  :class:`~ramba_tpu.serve.overload.Deadline` at flush prepare and
+  carried on the ticket/span; expired work is shed before dispatch
+  with a classified ``DeadlineExceededError``, the degradation ladder
+  skips rungs whose rolling p50 cannot fit the remaining budget, and
+  the elastic watchdog clamps to ``min(watchdog, remaining)``.
+* ``priority`` — exempts this session's flushes from brownout
+  shedding (``serve/overload.py``): under red brownout only priority
+  tenants are admitted.  Not a scheduling priority — fairness
+  rotation is unchanged.
 """
 
 from __future__ import annotations
@@ -86,7 +97,9 @@ class Session:
                  max_pending: Optional[int] = None,
                  quota=None,
                  pipeline: Optional["_pipeline.CompilePipeline"] = None,
-                 trace_id: Optional[str] = None):
+                 trace_id: Optional[str] = None,
+                 deadline_ms: Optional[float] = None,
+                 priority: bool = False):
         self.tenant = tenant
         self.pipeline = pipeline or _pipeline.get_pipeline()
         # causal trace root: every flush span of this session chains back
@@ -104,6 +117,8 @@ class Session:
         )
         self.stream.trace_id = self.trace_id
         self.stream.root_span = self.root_span
+        self.stream.deadline_ms = deadline_ms
+        self.stream.priority = bool(priority)
         # threshold auto-flushes stream through the pipeline instead of
         # blocking the build thread on a synchronous flush
         self.stream.on_threshold = self.pipeline.submit

@@ -49,6 +49,9 @@ _METRIC_DIRECTION = {
     "matmul_tflops": "higher",
     "serving_flushes_per_s": "higher",
     "serving_p95_flush_ms": "lower",
+    "goodput_flushes_per_s": "higher",  # admitted throughput at 3x load
+    "p95_admitted_ms": "lower",         # tail of the admitted set in-SLO
+    "shed_fail_fast_ms": "lower",       # classified-rejection fast path
     "memo_hit_rate": "higher",          # result-cache dedup (RAMBA_MEMO)
     "serving_dup_execs": "lower",       # duplicates that escaped batch CSE
     "observe_events_per_s": "higher",

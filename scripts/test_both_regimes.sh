@@ -30,3 +30,5 @@ echo "=== leg 12: staged resharding + live mesh elasticity (2-rank round-trip, 2
 python scripts/two_process_suite.py --reshard-leg
 echo "=== leg 13: effect-certified result memoization (2-rank lockstep cache) ==="
 python scripts/two_process_suite.py --memo-leg
+echo "=== leg 14: coherent load shedding (2-rank, rank-skewed serve:admit faults) ==="
+python scripts/two_process_suite.py --overload-leg

@@ -538,6 +538,37 @@ def _merge_line(e: dict) -> str:
         return (f"flush     {e.get('label', '?')}"
                 f" rung={e.get('degraded', 'fused')}"
                 f" wall={e.get('wall_s', 0):.4f}s")
+    if t == "shed":
+        line = (f"shed      {e.get('reason', '?')}"
+                f" stage={e.get('stage', '?')}")
+        if e.get("label"):
+            line += f" {e['label']}"
+        if e.get("tenant"):
+            line += f" tenant={e['tenant']}"
+        if e.get("epoch") is not None:
+            line += f" epoch={e['epoch']}"
+        return line
+    if t == "breaker":
+        line = (f"breaker   tenant={e.get('tenant', '?')}"
+                f" {e.get('from', '?')}->{e.get('to', '?')}"
+                f" failures={e.get('failures', '?')}")
+        if e.get("to") == "open":
+            line += " TRIPPED"
+        return line
+    if t == "hedge":
+        line = f"hedge     {e.get('action', '?')} {e.get('label', '?')}"
+        if e.get("action") == "fired":
+            line += (f" threshold={e.get('threshold_ms', '?')}ms"
+                     f" waited={e.get('waited_ms', '?')}ms")
+        elif e.get("action") == "resolved":
+            line += (f" winner={e.get('winner', '?')}"
+                     f" wall={e.get('wall_ms', '?')}ms")
+        return line
+    if t == "brownout":
+        return (f"brownout  {e.get('from', '?')}->{e.get('to', '?')}"
+                f" queue={e.get('queue_ratio', '?')}"
+                f" mem={e.get('memory_frac', '?')}"
+                f" slo_breached={e.get('slo_breached', '?')}")
     return t
 
 
@@ -607,7 +638,8 @@ def merge_report(path: str, per_rank: dict, file=None, cap: int = 80) -> None:
         t = e.get("type")
         if t in ("fault", "degrade", "slow_flush", "cache_evict",
                  "flush_error", "health", "serve_coalesce", "stall",
-                 "lifecycle", "coherence", "reshard"):
+                 "lifecycle", "coherence", "reshard", "shed", "breaker",
+                 "hedge", "brownout"):
             return True
         if t == "memory":
             return not (e.get("action") == "admit" and e.get("ok"))

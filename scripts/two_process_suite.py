@@ -368,6 +368,64 @@ print('SERVING_LEG_KEYS rank=%d %s' % (rank, ','.join(sorted(keys))))
 """
 
 
+# SPMD workload for the overload leg: a rank-skewed ``serve:admit`` fault
+# makes rank 1 PROPOSE shedding the first three flushes; under engaged
+# coherence the ``serve:shed`` agreement round must shed them on BOTH
+# ranks (identical verdict, same epoch) so the fleet never splits into
+# "rank 0 executed a collective rank 1 skipped".  With RAMBA_COHERENCE=off
+# the same seed must reproduce the divergence.  argv: <rank> <coordinator>.
+_OVERLOAD_WORKLOAD = """
+import os, sys, time
+import numpy as np
+rank, coord = int(sys.argv[1]), sys.argv[2]
+from ramba_tpu.parallel import distributed
+distributed.initialize(coordinator_address=coord, num_processes=2,
+                       process_id=rank)
+import jax
+assert jax.process_count() == 2, jax.process_count()
+import ramba_tpu as rt
+from ramba_tpu import serve
+from ramba_tpu.serve import overload
+from ramba_tpu.serve.pipeline import CompilePipeline
+coh = os.environ.get('RAMBA_COHERENCE', 'auto')
+pipe = CompilePipeline()
+pipe._ensure_worker = lambda: None  # lockstep: dispatch inline below
+arrs = []
+with serve.Session(tenant='ov', pipeline=pipe) as s:
+    for i in range(8):
+        a = rt.arange(4096) * float(i + 1) + 0.5
+        arrs.append(a)
+        t = s.flush()
+        group = pipe.queue.pop_group(1, timeout=5)
+        assert len(group) == 1, (i, len(group))
+        t0 = time.perf_counter()
+        pipe._dispatch_group(group)
+        try:
+            t.wait(timeout=120)
+            print('OVERLOAD_RESULT idx=%d verdict=OK' % i, flush=True)
+        except overload.ShedError as e:
+            wall_ms = (time.perf_counter() - t0) * 1e3
+            assert e.shed_classification == 'shed', e
+            assert wall_ms < 2000.0, wall_ms  # shed, not executed-then-failed
+            print('OVERLOAD_RESULT idx=%d verdict=SHED reason=%s epoch=%s'
+                  % (i, e.reason, e.epoch), flush=True)
+    if coh == 'on':
+        # both ranks shed the identical set, so the self-heal flushes
+        # below are the identical collective sequence on every rank
+        for i, a in enumerate(arrs):
+            got = float(np.asarray(a).sum())
+            exp = float((np.arange(4096) * float(i + 1) + 0.5).sum())
+            tag = 'OK' if abs(got - exp) <= 1e-3 * max(1.0, abs(exp)) else 'BAD'
+            print('OVERLOAD_HEAL idx=%d %s' % (i, tag), flush=True)
+    s.close(drain=False)
+pipe.stop()
+from ramba_tpu.observe import registry
+print('OVERLOAD_COUNTS shed=%d fault=%d' % (
+    registry.get('serve.shed'), registry.get('serve.shed.fault')),
+    flush=True)
+"""
+
+
 # SPMD workload for the telemetry leg: each rank opens a serving session
 # that JOINS one fixed trace_id (the same request fanned out across the
 # fleet), drives a traced flush through the pipeline seam inline, then
@@ -1667,6 +1725,160 @@ def run_chaos_leg() -> int:
     return 0 if ok else 1
 
 
+def _overload_env(trace_base: str, coherence: str) -> dict:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    for k in ("RAMBA_TEST_PROCS", "RAMBA_TEST_PROC_ID",
+              "RAMBA_TEST_COORD", "RAMBA_TEST_SHARED_TMP",
+              "RAMBA_PROFILE_DIR"):
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    # Rank 1 alone proposes shedding the first three flushes; the
+    # serve:shed agreement must make that the fleet-wide verdict.
+    env["RAMBA_FAULTS"] = "serve:admit:3:rank=1"
+    env["RAMBA_RETRY_BASE_S"] = "0.01"
+    env["RAMBA_WATCHDOG_S"] = "45"
+    env["RAMBA_COHERENCE"] = coherence
+    env["RAMBA_TRACE"] = trace_base
+    return env
+
+
+def _overload_run(basetemp: str, trace_base: str, coherence: str,
+                  budget: float, grace: float = 30.0):
+    """Launch both ranks with a straggler grace window (the OFF phase
+    intentionally splits the fleet and may wedge one rank on a
+    mismatched collective)."""
+    procs, logs = [], []
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    for rank in range(2):
+        log = open(os.path.join(basetemp,
+                                f"{coherence}.rank{rank}.log"), "w")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _OVERLOAD_WORKLOAD, str(rank),
+             f"localhost:{port}"],
+            env=_overload_env(trace_base, coherence),
+            stdout=log, stderr=subprocess.STDOUT, cwd=REPO,
+        ))
+    deadline = time.time() + budget
+    shrunk = False
+    rcs = [None, None]
+    try:
+        while any(rc is None for rc in rcs) and time.time() < deadline:
+            for i, p in enumerate(procs):
+                if rcs[i] is None and p.poll() is not None:
+                    rcs[i] = p.returncode
+            if not shrunk and sum(rc is not None for rc in rcs) == 1:
+                deadline = min(deadline, time.time() + grace)
+                shrunk = True
+            time.sleep(0.25)
+        for i, p in enumerate(procs):
+            if rcs[i] is None:
+                p.kill()
+                p.wait()
+                rcs[i] = -9
+    finally:
+        for log in logs:
+            log.close()
+    return rcs
+
+
+def _overload_markers(basetemp: str, coherence: str, rank: int) -> list:
+    path = os.path.join(basetemp, f"{coherence}.rank{rank}.log")
+    try:
+        with open(path) as f:
+            return [ln.strip() for ln in f
+                    if ln.startswith(("OVERLOAD_RESULT ", "OVERLOAD_HEAL ",
+                                      "OVERLOAD_COUNTS "))]
+    except OSError:
+        return []
+
+
+def run_overload_leg() -> int:
+    """Coherent load shedding under rank-skewed admission faults: ON
+    sheds byte-identically on every rank (same set, same epoch, zero
+    stalls, zero local fallbacks); OFF reproduces the divergence."""
+    import json
+
+    basetemp = tempfile.mkdtemp(prefix="ramba_2proc_overload_")
+    budget = float(os.environ.get("RAMBA_TEST_PROCS_TIMEOUT", "600"))
+    ok = True
+
+    # ---- phase ON: the shed verdict is epoch-agreed --------------------
+    trace_on = os.path.join(basetemp, "trace_on.jsonl")
+    rcs = _overload_run(basetemp, trace_on, "on", budget)
+    if rcs != [0, 0]:
+        print(f"overload leg ON: FAIL (rcs={rcs}, expected clean exits)")
+        ok = False
+    marks = [_overload_markers(basetemp, "on", r) for r in range(2)]
+    sheds = [[ln for ln in marks[r] if "verdict=SHED" in ln]
+             for r in range(2)]
+    print(f"overload leg ON: markers {len(marks[0])}/{len(marks[1])}, "
+          f"sheds {len(sheds[0])}/{len(sheds[1])}")
+    if not marks[0] or marks[0] != marks[1]:
+        print("overload leg ON: FAIL (marker lines diverge across ranks)")
+        for l0, l1 in zip(marks[0], marks[1]):
+            if l0 != l1:
+                print(f"  rank0: {l0}\n  rank1: {l1}")
+        ok = False
+    if len(sheds[0]) != 3 or any("epoch=None" in ln for ln in sheds[0]):
+        print(f"overload leg ON: FAIL (expected 3 epoch-stamped sheds, "
+              f"got {sheds[0]})")
+        ok = False
+    if any("BAD" in ln for ln in marks[0] + marks[1]):
+        print("overload leg ON: FAIL (shed array healed to wrong bytes)")
+        ok = False
+    for rank in range(2):
+        path = f"{trace_on}.rank{rank}"
+        try:
+            with open(path) as f:
+                evs = [json.loads(ln) for ln in f if ln.strip()]
+        except (OSError, ValueError) as e:
+            print(f"overload leg ON: FAIL (trace rank {rank}: {e})")
+            ok = False
+            continue
+        stalls = sum(1 for e in evs if e.get("type") == "stall")
+        local = sum(1 for e in evs if e.get("type") == "coherence"
+                    and e.get("outcome") == "local")
+        shed_evs = [e for e in evs if e.get("type") == "shed"
+                    and e.get("stage") == "dispatch"]
+        if stalls or local:
+            print(f"overload leg ON: FAIL (rank {rank}: {stalls} stalls, "
+                  f"{local} local coherence rounds — agreement broke)")
+            ok = False
+        if len(shed_evs) != 3 or any(not e.get("epoch")
+                                     for e in shed_evs):
+            print(f"overload leg ON: FAIL (rank {rank}: shed trace events "
+                  f"{len(shed_evs)}, expected 3 epoch-stamped)")
+            ok = False
+
+    # ---- phase OFF: same seed, no agreement → rank 1 sheds alone -------
+    trace_off = os.path.join(basetemp, "trace_off.jsonl")
+    off_rcs = _overload_run(basetemp, trace_off, "off",
+                            min(budget, 150.0), grace=20.0)
+    off_marks = [_overload_markers(basetemp, "off", r) for r in range(2)]
+    diverged = off_rcs != [0, 0] or off_marks[0] != off_marks[1]
+    print(f"overload leg OFF: rcs={off_rcs}, markers "
+          f"{len(off_marks[0])}/{len(off_marks[1])} "
+          f"(identical={off_marks[0] == off_marks[1]})")
+    if not diverged:
+        print("overload leg OFF: FAIL (coherence off did NOT reproduce "
+              "the shed divergence — the ON result proves nothing)")
+        ok = False
+    else:
+        print("overload leg OFF: divergence reproduced (expected)")
+
+    print(f"two-process overload leg: {'OK' if ok else 'FAIL'}")
+    if ok:
+        shutil.rmtree(basetemp, ignore_errors=True)
+    else:
+        print(f"overload leg artifacts kept at {basetemp}")
+    return 0 if ok else 1
+
+
 def run_fault_leg() -> int:
     """Two ranks, one injected compile fault each; both must recover."""
     with socket.socket() as s:
@@ -1771,6 +1983,8 @@ def main() -> int:
         return run_autotune_leg()
     if "--memo-leg" in sys.argv[1:]:
         return run_memo_leg()
+    if "--overload-leg" in sys.argv[1:]:
+        return run_overload_leg()
     pytest_args = sys.argv[1:] or ["tests/"]
     with socket.socket() as s:
         s.bind(("localhost", 0))
