@@ -37,6 +37,12 @@ Rules
                        an input, or whose result alias-escapes an input
                        — the seeded violation of the ``memo:insert`` /
                        ``memo:hit`` fault sites.
+``compile-class``      a shape-bucket plan (``compile/classes.py``)
+                       claiming pad/slice safety for a program with a
+                       shape-sensitive instruction, or whose bucket
+                       arithmetic disagrees with an independent
+                       re-derivation from the leaf avals — the seeded
+                       violation of the ``compile:bucket`` fault site.
 """
 
 from __future__ import annotations
@@ -605,5 +611,67 @@ def check_memo_safety(view: "ProgramView") -> List[Finding]:
             "memo-safety", "error", "program",
             "memoized program donates input buffers; a replayed hit "
             "would skip the donation the alias census already assumed",
+        ))
+    return fs
+
+
+@rule("compile-class")
+def check_compile_class(view: "ProgramView") -> List[Finding]:
+    """Audit a flush's shape-bucket plan (``compile/classes.py``):
+    re-prove the pad/slice safety claim *independently* of the planner
+    (the ``compile:bucket`` fault site forges a plan that skips the
+    op-safety proof — exactly the corruption this rule catches).  Two
+    halves: every instruction must be leading-dim independent
+    (``classes.check_program``), and the bucket arithmetic must agree
+    with a fresh re-derivation from the leaf avals.  No plan is
+    vacuously safe (exact-shape compiles never pad)."""
+    fs: List[Finding] = []
+    plan = view.class_plan
+    prog = view.program
+    if plan is None or prog is None:
+        return fs
+    from ramba_tpu.compile import classes as _classes
+
+    reason = _classes.check_program(prog)
+    if reason is not None:
+        fs.append(Finding(
+            "compile-class", "error", "program",
+            f"bucket plan claims pad/slice safety but {reason}: padded "
+            "rows would change the program's semantics, and slicing the "
+            "output could not undo it",
+        ))
+        return fs
+    try:
+        token = plan.token
+        policy = (("linear", int(token[0].split(":", 1)[1]))
+                  if str(token[0]).startswith("linear") else ("pow2",))
+        lavals = [leaf.aval for leaf in view.leaves]
+    except Exception:
+        fs.append(Finding(
+            "compile-class", "error", "program",
+            "bucket plan is malformed (unreadable token or leaf avals); "
+            "refusing to execute a padded program on an unverifiable "
+            "claim",
+        ))
+        return fs
+    rederived = _classes.shape_plan(prog, lavals, policy)
+    if rederived is None:
+        fs.append(Finding(
+            "compile-class", "error", "program",
+            "bucket plan's shape claim does not re-derive: the program's "
+            "leaf/output extents do not admit a single shared leading "
+            "dim to bucket",
+        ))
+        return fs
+    if (rederived.n != plan.n or rederived.bucket != plan.bucket
+            or rederived.bucket != _classes.bucket_for(plan.n, policy)
+            or tuple(rederived.pad_slots) != tuple(plan.pad_slots)):
+        fs.append(Finding(
+            "compile-class", "error", "program",
+            f"bucket arithmetic disagrees with re-derivation: plan "
+            f"(n={plan.n}, bucket={plan.bucket}, "
+            f"pads={list(plan.pad_slots)}) vs re-derived "
+            f"(n={rederived.n}, bucket={rederived.bucket}, "
+            f"pads={list(rederived.pad_slots)})",
         ))
     return fs

@@ -80,6 +80,9 @@ class ProgramView:
     # override (None means the process-wide one in rules.py)
     memo_plan: Any = None
     canon_registry: Optional[MutableMapping[str, str]] = None
+    # compile-class audit surface: the flush's compile/classes.py bucket
+    # plan (compile-class rule input); None = exact-shape compile
+    class_plan: Any = None
 
 
 def verify_program(
@@ -111,12 +114,14 @@ def verify_flush(
     donate: Sequence[int],
     label: Optional[str] = None,
     memo_plan: Any = None,
+    class_plan: Any = None,
 ) -> List[Finding]:
     """Verify the program a flush is about to execute, emitting each
     finding through ``observe/events.py`` (so ``trace_report.py`` renders
     them) and counting per-severity registry metrics.  ``memo_plan`` is
     the flush's result-memoization plan, audited by the memo-safety
-    rule."""
+    rule; ``class_plan`` its compile-class bucket plan, audited by the
+    compile-class rule."""
     from ramba_tpu import common as _common
     from ramba_tpu.core import fuser as _fuser
 
@@ -128,6 +133,7 @@ def verify_flush(
         owners=_fuser._leaf_owner_counts(leaves),
         seg_size=_common.max_program_instrs,
         memo_plan=memo_plan,
+        class_plan=class_plan,
     )
     findings = verify_program(view)
     for f in findings:

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import os
 import sys
+from typing import NamedTuple
 
 
 _FALSY = ("0", "", "false", "False", "FALSE", "no", "NO", "off", "OFF")
@@ -107,33 +108,80 @@ num_workers_env = os.environ.get("RAMBA_WORKERS", None)
 cache_env = os.environ.get("RAMBA_CACHE", None)
 
 
-def setup_persistent_cache() -> str | None:
-    """Enable the on-disk XLA executable cache if RAMBA_CACHE is set.
-    Returns the cache directory (or None if disabled)."""
-    if not cache_env or cache_env in _FALSY:
+class CacheStatus(NamedTuple):
+    """Typed result of :func:`setup_persistent_cache` — init failure is
+    a reportable state, not a silent no-op."""
+
+    path: str | None   # resolved cache directory (None = disabled)
+    ok: bool           # every init step succeeded (True when disabled)
+    error: str | None  # first failure, when ok is False
+
+    @property
+    def enabled(self) -> bool:
+        return self.path is not None
+
+
+def persistent_cache_path() -> str | None:
+    """Resolve the RAMBA_CACHE directory (None when disabled).  Reads
+    the live environment so tests (and the compile/persist subsystem)
+    see runtime toggles, not the import-time snapshot."""
+    env = os.environ.get("RAMBA_CACHE", cache_env)
+    if not env or env in _FALSY:
         return None
-    if cache_env in _TRUTHY:
-        path = os.path.expanduser("~/.ramba_tpu_xla_cache")
-    else:
-        path = os.path.expanduser(cache_env)
-    import jax
+    if env in _TRUTHY:
+        return os.path.expanduser("~/.ramba_tpu_xla_cache")
+    return os.path.expanduser(env)
 
-    os.makedirs(path, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", path)
-    # The reference caches every generated kernel regardless of compile time.
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    # jax initializes the persistent cache lazily on the *first* compile and
-    # latches that state — if anything compiled before RAMBA_CACHE was
-    # applied (cache dir None at the time), the new dir is silently ignored.
-    # Force re-initialization so the dir takes effect mid-process.
+
+def setup_persistent_cache() -> CacheStatus:
+    """Enable the on-disk XLA executable cache if RAMBA_CACHE is set.
+
+    Returns a :class:`CacheStatus`; emits a ``compile.persist_init``
+    event when the cache is enabled so traces record whether a process
+    actually armed its cache (a misconfigured dir used to be silently
+    ignored)."""
+    path = persistent_cache_path()
+    if path is None:
+        return CacheStatus(None, True, None)
+    error = None
     try:
-        from jax.experimental.compilation_cache import compilation_cache as _cc
+        import jax
 
-        _cc.reset_cache()
-    except Exception:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # The reference caches every generated kernel regardless of
+        # compile time.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception as e:  # noqa: BLE001 — config failure must not kill import
+        error = f"{type(e).__name__}: {e}"
+    if error is None:
+        # jax initializes the persistent cache lazily on the *first*
+        # compile and latches that state — if anything compiled before
+        # RAMBA_CACHE was applied (cache dir None at the time), the new
+        # dir is silently ignored.  Force re-initialization so the dir
+        # takes effect mid-process.
+        try:
+            from jax.experimental.compilation_cache import (
+                compilation_cache as _cc,
+            )
+
+            _cc.reset_cache()
+        except Exception as e:  # noqa: BLE001 — reset is best-effort
+            error = f"reset_cache: {type(e).__name__}: {e}"
+    status = CacheStatus(path, error is None, error)
+    try:
+        from ramba_tpu.observe import events as _events
+
+        _events.emit({
+            "type": "compile.persist_init",
+            "path": status.path,
+            "ok": status.ok,
+            "error": status.error,
+        })
+    except Exception:  # noqa: BLE001 — observability must not break init
         pass
-    return path
+    return status
 
 
 def dprint(level: int, *args) -> None:

@@ -1,0 +1,34 @@
+"""Compilation as a managed resource: shape-bucketed compile classes,
+a first-class persistent AOT executable cache, and a trace-replay warm
+pool.
+
+Three cooperating pieces (see docs/index.md "Compile classes & warm
+start"):
+
+* ``classes``  — ``RAMBA_COMPILE_CLASSES`` bucket policy: pads dynamic
+  leading dims up to a small set of bucket sizes at flush-prepare time
+  so a million distinct request shapes map onto a handful of
+  executables.
+* ``persist``  — the persistent executable cache: atomic cache-dir
+  ownership, ledger-accounted per-entry hit/miss/bytes, corruption
+  tolerated by evict-and-recompile, plus an AOT lane that serializes
+  ``jit(...).lower().compile()`` executables for the top-K fingerprints
+  so a second process starts with near-zero compile wall.
+* ``warmpool`` — replays ``RAMBA_TRACE`` program events through
+  ``CompilePipeline.submit_warm`` to pre-compile the top-K
+  (fingerprint, compile-class) pairs before traffic arrives.
+
+Submodules are imported lazily: ``core/fuser.py`` imports ``classes``
+and ``persist`` directly, and ``warmpool`` imports the fuser — an eager
+package import here would be a cycle.
+"""
+
+__all__ = ["classes", "persist", "warmpool"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        import importlib
+
+        return importlib.import_module(f"{__name__}.{name}")
+    raise AttributeError(name)

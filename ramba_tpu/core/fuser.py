@@ -53,6 +53,8 @@ from typing import Optional, Sequence
 import jax
 
 from ramba_tpu import common
+from ramba_tpu.compile import classes as _classes
+from ramba_tpu.compile import persist as _persist
 from ramba_tpu.core import memo as _memo
 from ramba_tpu.core.expr import Const, Expr, Node, Scalar, OPS
 from ramba_tpu.observe import events as _events
@@ -620,14 +622,21 @@ def _semantic_fingerprint() -> tuple:
     return (bool(jax.config.jax_enable_x64),)
 
 
-def _cache_key(program: _Program, donate_key: tuple) -> tuple:
+def _cache_key(program: _Program, donate_key: tuple,
+               compile_class=None) -> tuple:
     """Full compile-cache key: structure + donation mask + the trace-time
-    semantic fingerprint."""
-    return (program.key, donate_key, _semantic_fingerprint())
+    semantic fingerprint (+ the shape-bucket compile class, when the
+    flush was bucketed — bucketed and exact-shape executables must never
+    share an entry)."""
+    if compile_class is None:
+        return (program.key, donate_key, _semantic_fingerprint())
+    return (program.key, donate_key, _semantic_fingerprint(),
+            ("class",) + tuple(compile_class))
 
 
 def _get_compiled(program: _Program, donate_key: tuple,
-                  leaf_vals=None, force_backend: Optional[str] = None):
+                  leaf_vals=None, force_backend: Optional[str] = None,
+                  compile_class=None):
     """Compile-cache lookup (mesh-epoch aware, true LRU).  Returns
     ``(fn, is_new, fingerprint, backend)`` where ``fingerprint`` is the
     stable per-kernel key the cost ledger files this program under and
@@ -650,7 +659,7 @@ def _get_compiled(program: _Program, donate_key: tuple,
         if _cache_epoch != _mesh.mesh_epoch:
             _compile_cache.clear()
             _cache_epoch = _mesh.mesh_epoch
-        key = _cache_key(program, donate_key)
+        key = _cache_key(program, donate_key, compile_class)
         fp = _ledger.fingerprint(key)
         if force_backend is not None:
             backend = force_backend
@@ -695,6 +704,18 @@ def _get_compiled(program: _Program, donate_key: tuple,
                 "key": _ledger.fingerprint(old_key),
                 "capacity": _COMPILE_CACHE_MAX,
             })
+        # Persistent AOT lane (compile/persist.py): a compile-cache miss
+        # consults the on-disk executable cache before paying a compile.
+        # A deserialized executable is a hit for accounting purposes —
+        # is_new stays False so the ledger shows near-zero compile wall
+        # in a warm process.
+        if (leaf_vals is not None and backend != "pallas"
+                and build is None and _persist.armed()):
+            aot = _persist.lookup(fp, leaf_vals, program, donate_key)
+            if aot is not None:
+                _compile_cache[cache_key] = aot
+                _ledger.record_cache(fp, "miss")
+                return aot, False, fp, backend
         _faults.check("compile", instrs=len(program.instrs))
         fn = jax.jit(build if build is not None
                      else _build_callable(program),
@@ -704,6 +725,12 @@ def _get_compiled(program: _Program, donate_key: tuple,
             stats["compiles"] += 1
         _registry.inc("fuser.cache_miss")
         _ledger.record_cache(fp, "miss")
+        if (leaf_vals is not None and backend != "pallas"
+                and build is None and _persist.armed()):
+            # register as an AOT candidate (compiles are rare; the one
+            # small program-skeleton write stays off the steady state)
+            _persist.note_compiled(fp, program, donate_key, leaf_vals,
+                                   compile_class=compile_class)
         return fn, True, fp, backend
 
 
@@ -948,9 +975,16 @@ def _execute_compiled(fn, program: _Program, leaf_vals, is_new: bool,
 
 
 def _attempt_fused(program: _Program, leaf_vals, donate_key: tuple,
-                   span: Optional[dict]):
+                   span: Optional[dict], class_plan=None):
     """Rung 0: the normal fused path (monolithic jit, or the standard
     segmented executor above ``common.max_program_instrs``).  With
+    ``RAMBA_COMPILE_CLASSES`` armed and a bucket plan certified for this
+    flush, leaves are zero-padded up to the bucket before execution and
+    outputs sliced back to the exact extent — the pad/slice wrapper that
+    lets a million request shapes share one executable.  Only this rung
+    buckets: the lower resilience rungs always run exact shapes, and the
+    padded copies are fresh temporaries so donating them is safe while
+    the original leaves stay alive for any fallback.  With
     ``RAMBA_AUTOTUNE`` armed this is where the backend race plays out:
     the autotuner may hand back the Pallas lowering, whose first
     (compile-paying) call is deferred through the async compile pipeline
@@ -962,8 +996,19 @@ def _attempt_fused(program: _Program, leaf_vals, donate_key: tuple,
         and len(program.instrs) > common.max_program_instrs
     ):
         return _run_segmented(program, leaf_vals, donate_key, span=span)
+    if class_plan is not None:
+        padded = _classes.apply(class_plan, leaf_vals)
+        outs = _attempt_fused_exec(program, padded, donate_key, span,
+                                   compile_class=class_plan.token)
+        return _classes.strip(class_plan, outs)
+    return _attempt_fused_exec(program, leaf_vals, donate_key, span)
+
+
+def _attempt_fused_exec(program: _Program, leaf_vals, donate_key: tuple,
+                        span: Optional[dict], compile_class=None):
     fn, is_new, fp, backend = _get_compiled(program, donate_key,
-                                            leaf_vals=leaf_vals)
+                                            leaf_vals=leaf_vals,
+                                            compile_class=compile_class)
     if backend == "pallas":
         from ramba_tpu.core import autotune as _autotune
 
@@ -988,7 +1033,7 @@ def _attempt_fused(program: _Program, leaf_vals, donate_key: tuple,
                 _autotune.maybe_prewarm(fp, program, leaf_vals, donate_key)
                 fn, is_new, fp, backend = _get_compiled(
                     program, donate_key, leaf_vals=leaf_vals,
-                    force_backend="xla")
+                    force_backend="xla", compile_class=compile_class)
                 return _execute_compiled(
                     fn, program, leaf_vals, is_new, span=span, fp=fp,
                     rung="fused", donated=len(donate_key), backend=backend)
@@ -1013,7 +1058,8 @@ def _attempt_fused(program: _Program, leaf_vals, donate_key: tuple,
             _autotune.note_failure(fp, "pallas", e)
             with _cache_lock:
                 _compile_cache.pop(
-                    _cache_key(program, donate_key) + ("pallas",), None)
+                    _cache_key(program, donate_key, compile_class)
+                    + ("pallas",), None)
             _events.emit({
                 "type": "degrade", "site": "backend", "action": "backend",
                 "from": "pallas", "to": "xla",
@@ -1021,7 +1067,7 @@ def _attempt_fused(program: _Program, leaf_vals, donate_key: tuple,
             })
             fn, is_new, fp, backend = _get_compiled(
                 program, donate_key, leaf_vals=leaf_vals,
-                force_backend="xla")
+                force_backend="xla", compile_class=compile_class)
             return _execute_compiled(
                 fn, program, leaf_vals, is_new, span=span, fp=fp,
                 rung="fused", donated=len(donate_key), backend=backend)
@@ -1109,7 +1155,7 @@ def _execute_resilient(program: _Program, leaf_vals, donate_key: tuple,
                        span: Optional[dict], skip_fused: bool = False,
                        route_chunked: bool = False,
                        tags: Optional[dict] = None,
-                       deadline=None):
+                       deadline=None, class_plan=None):
     """Run the program down the degradation ladder (see
     ``resilience.degrade``): fused → split → chunked → eager → host.
     Returns ``(outs, rung_name)``; rung_name is "fused" on the healthy
@@ -1140,7 +1186,8 @@ def _execute_resilient(program: _Program, leaf_vals, donate_key: tuple,
     if not skip_fused and not route_chunked:
         rungs.append(
             ("fused",
-             lambda: _attempt_fused(program, leaf_vals, donate_key, span)))
+             lambda: _attempt_fused(program, leaf_vals, donate_key, span,
+                                    class_plan=class_plan)))
     if (len(program.instrs) > 1 or skip_fused) and not route_chunked:
         cap = common.max_program_instrs or len(program.instrs)
         half = max(1, min(len(program.instrs), cap) // 2)
@@ -1244,13 +1291,16 @@ def _leaf_owner_counts(leaves) -> list:
 
 
 def _program_event(program: _Program, leaves, donate_key: tuple,
-                   label: str) -> dict:
+                   label: str, fingerprint: Optional[str] = None,
+                   compile_class=None) -> dict:
     """Offline-lintable record of the program a flush is about to run —
     ``python -m ramba_tpu.analyze`` re-checks graph hygiene and donation
-    hazards from these events without the live process.  Statics are
-    repr-truncated: the offline rules need structure (op names, slot refs,
-    donate mask, owner counts), not closure identities."""
-    return {
+    hazards from these events without the live process, and the warm
+    pool (``compile/warmpool.py``) ranks traces by the fingerprint +
+    compile class recorded here.  Statics are repr-truncated: the
+    offline rules need structure (op names, slot refs, donate mask,
+    owner counts), not closure identities."""
+    ev = {
         "type": "program", "label": label,
         "instrs": [[op, repr(st)[:160], list(args)]
                    for op, st, args in program.instrs],
@@ -1261,10 +1311,16 @@ def _program_event(program: _Program, leaves, donate_key: tuple,
         "owners": _leaf_owner_counts(leaves),
         "x64": bool(jax.config.jax_enable_x64),
     }
+    if fingerprint is not None:
+        ev["fingerprint"] = fingerprint
+    if compile_class is not None:
+        ev["compile_class"] = list(compile_class)
+    return ev
 
 
 def _verify_if_enabled(program: _Program, leaves, exprs, donate_key: tuple,
-                       span: dict, label: str, memo_plan=None) -> bool:
+                       span: dict, label: str, memo_plan=None,
+                       class_plan=None) -> bool:
     """RAMBA_VERIFY hook: statically verify the program about to execute
     (see ramba_tpu.analyze).  Strict mode raises ProgramVerificationError
     on error findings — before ``_get_compiled`` is ever reached, so a
@@ -1280,7 +1336,8 @@ def _verify_if_enabled(program: _Program, leaves, exprs, donate_key: tuple,
     if vmode == "off":
         return False
     findings = _verifier.verify_flush(program, leaves, exprs, donate_key,
-                                      label=label, memo_plan=memo_plan)
+                                      label=label, memo_plan=memo_plan,
+                                      class_plan=class_plan)
     if findings:
         counts: dict = {}
         for f in findings:
@@ -1313,7 +1370,7 @@ class _FlushWork:
                  "leaves", "vexprs", "leaf_vals", "donate_key", "span",
                  "label", "fingerprint", "skip_fused", "pins", "flight",
                  "t_flush", "detached", "enqueued_at", "memo_plan",
-                 "memo_hit", "deadline", "is_abandoned")
+                 "memo_hit", "deadline", "is_abandoned", "class_plan")
 
     def __init__(self, stream, roots, extra_n):
         self.stream = stream
@@ -1343,6 +1400,8 @@ class _FlushWork:
         # completions discard instead of writing back)
         self.deadline = None
         self.is_abandoned = None
+        # shape-bucket compile class (compile/classes.py); None = exact
+        self.class_plan = None
 
 
 def _gather_leaf_vals(leaves):
@@ -1519,8 +1578,45 @@ def _flush_prepare(stream: FlushStream, roots: list,
         span["donated"] = len(donate_key)
         span["leaf_bytes"] = leaf_bytes
         span["mem_live_bytes"] = _memory.ledger.live_bytes
+        # Compile-class planning (RAMBA_COMPILE_CLASSES): bucket the
+        # leading dim so shape-varying traffic shares executables.  The
+        # decision is a pure function of (program, shapes, policy), so
+        # SPMD ranks agree by construction.  The compile:bucket fault
+        # site forges a plan that skips the op-safety proof — the
+        # seeded violation the compile-class verify rule exists to
+        # catch.
+        class_plan = None
+        if _classes.enabled():
+            try:
+                class_plan = _classes.plan_for(program, leaf_vals)
+            except Exception:
+                class_plan = None
+        try:
+            _faults.check("compile:bucket", label=label)
+        except _faults.InjectedFault:
+            forged = _classes.forced_plan(program, leaf_vals)
+            if forged is not None:
+                class_plan = forged
+        work.class_plan = class_plan
+        if class_plan is not None:
+            span["compile_class"] = list(class_plan.token)
+            span["pad_waste_bytes"] = class_plan.pad_waste_bytes
+        # The fingerprint folds in the class token: each bucket is its
+        # own executable, its own ledger row, its own persist entry.
+        work.fingerprint = _ledger.fingerprint(_cache_key(
+            program, donate_key,
+            class_plan.token if class_plan is not None else None))
+        if _classes.enabled():
+            _classes.note_decision(work.fingerprint, class_plan)
+        if class_plan is not None:
+            _ledger.record_class(work.fingerprint, class_plan.token,
+                                 class_plan.pad_waste_bytes, label=label)
         if _events.trace_enabled():
-            pev = _program_event(program, leaves, donate_key, label)
+            pev = _program_event(
+                program, leaves, donate_key, label,
+                fingerprint=work.fingerprint,
+                compile_class=(class_plan.token
+                               if class_plan is not None else None))
             if "trace_id" in span:
                 pev.setdefault("trace_id", span["trace_id"])
                 pev.setdefault("parent_span", span["span_id"])
@@ -1547,7 +1643,7 @@ def _flush_prepare(stream: FlushStream, roots: list,
     try:
         work.skip_fused = _verify_if_enabled(
             program, leaves, vexprs, donate_key, span, label,
-            memo_plan=work.memo_plan,
+            memo_plan=work.memo_plan, class_plan=work.class_plan,
         )
     except Exception as e:
         _quarantine(work, e)
@@ -1556,9 +1652,11 @@ def _flush_prepare(stream: FlushStream, roots: list,
     if work.skip_fused:
         # a verifier-distrusted flush must not populate (or consult) the
         # result cache: whatever routed it down the ladder may be the
-        # very defect the memo-safety rule flagged
+        # very defect the memo-safety rule flagged.  The class plan is
+        # dropped for the same reason — the ladder's fallback rungs run
+        # exact shapes, so a flagged bucket claim never touches data.
         work.memo_plan = None
-    work.fingerprint = _ledger.fingerprint(_cache_key(program, donate_key))
+        work.class_plan = None
     if work.memo_plan is not None:
         try:
             work.memo_hit = _memo.lookup(work.memo_plan)
@@ -1591,8 +1689,9 @@ def _revalidate_donation(work: "_FlushWork") -> None:
         work.span["donate_revoked"] = len(work.donate_key) - len(kept)
         work.donate_key = kept
         work.span["donated"] = len(kept)
-        work.fingerprint = _ledger.fingerprint(
-            _cache_key(work.program, kept))
+        work.fingerprint = _ledger.fingerprint(_cache_key(
+            work.program, kept,
+            work.class_plan.token if work.class_plan is not None else None))
 
 
 def _finish_memo_hit(work: "_FlushWork") -> list:
@@ -1713,7 +1812,8 @@ def _flush_dispatch_traced(work: "_FlushWork", *, coalesced: int = 0) -> list:
                             program, leaf_vals, work.donate_key, hspan,
                             skip_fused=work.skip_fused,
                             route_chunked=route_chunked, tags=tags,
-                            deadline=work.deadline),
+                            deadline=work.deadline,
+                            class_plan=work.class_plan),
                         hedge_s, span=span, label=label,
                         tenant=stream.tenant)
                 else:
@@ -1721,7 +1821,8 @@ def _flush_dispatch_traced(work: "_FlushWork", *, coalesced: int = 0) -> list:
                         program, leaf_vals, work.donate_key, span,
                         skip_fused=work.skip_fused,
                         route_chunked=route_chunked, tags=tags,
-                        deadline=work.deadline)
+                        deadline=work.deadline,
+                        class_plan=work.class_plan)
     except Exception as e:
         _quarantine(work, e)
         raise

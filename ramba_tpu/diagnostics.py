@@ -66,7 +66,11 @@ def perf_report() -> dict:
     windows and the slow-flush sentinel tally.  This is the capture
     format ``scripts/perf_diff.py`` compares.  When the backend
     autotuner is active (or has latched decisions), an ``autotune``
-    section reports its mode, decision table, and race overhead."""
+    section reports its mode, decision table, and race overhead.  When
+    compile classes or the persistent AOT cache are in play, a
+    ``compile`` section carries their counters plus the warm-vs-demand
+    compile split (what the warm pool pre-paid vs. what requests
+    paid)."""
     from ramba_tpu.observe import ledger as _ledger
 
     snap = _ledger.snapshot()
@@ -78,7 +82,46 @@ def perf_report() -> dict:
             snap["autotune"] = rep
     except Exception:
         pass
+    try:
+        snap.update(_compile_section(snap))
+    except Exception:
+        pass
     return snap
+
+
+def _compile_section(perf_snap: dict) -> dict:
+    """The ``compile`` section of :func:`perf_report`: compile-class and
+    persist-cache snapshots plus the warm-vs-demand compile split summed
+    over the kernel ledger.  Empty when the whole subsystem is idle so
+    historical captures keep their shape."""
+    from ramba_tpu.compile import classes as _classes
+    from ramba_tpu.compile import persist as _persist
+
+    csnap = _classes.snapshot()
+    psnap = _persist.snapshot()
+    total_c, total_s, warm_c, warm_s = 0, 0.0, 0, 0.0
+    for k in perf_snap.get("kernels", {}).values():
+        total_c += k.get("compiles", 0)
+        total_s += k.get("compile_s", 0.0)
+        warm_c += k.get("warm_compiles", 0)
+        warm_s += k.get("warm_compile_s", 0.0)
+    active = (csnap.get("mode") != "off" or csnap.get("planned")
+              or csnap.get("bailouts") or psnap.get("armed")
+              or psnap.get("hits") or psnap.get("misses") or warm_c)
+    if not active:
+        return {}
+    return {"compile": {
+        "classes": csnap,
+        "persist": psnap,
+        "compiles": {
+            "total": total_c,
+            "total_s": round(total_s, 6),
+            "warm": warm_c,
+            "warm_s": round(warm_s, 6),
+            "demand": total_c - warm_c,
+            "demand_s": round(total_s - warm_s, 6),
+        },
+    }}
 
 
 def serving_report() -> dict:
@@ -234,6 +277,29 @@ def report(file=None) -> None:
             print(line, file=file)
         if perf["slow_flushes"]:
             print(f"  slow flushes: {perf['slow_flushes']}", file=file)
+    comp = perf.get("compile")
+    if comp:
+        print("-- compile --", file=file)
+        c, p, t = comp["classes"], comp["persist"], comp["compiles"]
+        print(
+            f"  classes mode={c['mode']} planned={c['planned']}"
+            f" padded={c['padded']} bailouts={c['bailouts']}"
+            f" pad_waste={c['pad_waste_frac']:.1%}",
+            file=file,
+        )
+        print(
+            f"  persist armed={'yes' if p['armed'] else 'no'}"
+            f" hits={p['hits']} misses={p['misses']} corrupt={p['corrupt']}"
+            f" stores={p['stores']} bytes_rw={p['bytes_read']:,d}"
+            f"/{p['bytes_written']:,d}",
+            file=file,
+        )
+        print(
+            f"  compiles total={t['total']} ({t['total_s']:.4f}s)"
+            f" warm={t['warm']} ({t['warm_s']:.4f}s)"
+            f" demand={t['demand']} ({t['demand_s']:.4f}s)",
+            file=file,
+        )
     memo = memo_report()
     if memo["enabled"] or memo["inserts"] or memo["hits"]:
         print("-- result memo --", file=file)

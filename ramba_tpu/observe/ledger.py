@@ -54,6 +54,7 @@ import hashlib
 import os
 import threading
 from collections import deque
+from contextlib import contextmanager
 from typing import Optional
 
 from ramba_tpu.observe import events as _events
@@ -266,6 +267,7 @@ class KernelEntry:
 
     __slots__ = (
         "label", "instrs", "donated", "compiles", "compile_s",
+        "warm_compiles", "warm_compile_s", "compile_class", "pad_waste",
         "exec", "sync", "bytes_in", "bytes_out",
         "hits", "misses", "evicts", "rungs", "tenants",
         "flops", "bytes_accessed", "_cost_tried", "backends",
@@ -277,6 +279,15 @@ class KernelEntry:
         self.donated = donated
         self.compiles = 0
         self.compile_s = 0.0
+        # warm-pool attribution: compiles paid proactively (trace replay
+        # through submit_warm) vs. on the demand path.  Zero outside the
+        # warm pool so historical summaries keep their shape.
+        self.warm_compiles = 0
+        self.warm_compile_s = 0.0
+        # compile-class decision for this kernel (token like
+        # ("pow2", 64)) and cumulative pad-waste bytes charged to it
+        self.compile_class = None
+        self.pad_waste = 0
         self.exec = _Rolling()
         self.sync: Optional[_Rolling] = None
         self.bytes_in = 0
@@ -324,6 +335,12 @@ class KernelEntry:
             out["flops"] = self.flops
         if self.bytes_accessed is not None:
             out["bytes_accessed"] = self.bytes_accessed
+        if self.warm_compiles:
+            out["warm_compiles"] = self.warm_compiles
+            out["warm_compile_s"] = round(self.warm_compile_s, 6)
+        if self.compile_class is not None:
+            out["compile_class"] = list(self.compile_class)
+            out["pad_waste"] = self.pad_waste
         if self.backends:
             out["backends"] = {name: b.summary()
                                for name, b in self.backends.items()}
@@ -354,6 +371,68 @@ def _entry(fp: str, label: Optional[str] = None, instrs: int = 0,
     return e
 
 
+# Compile-source attribution (thread-local): the serve pipeline wraps
+# warm-ticket thunks in compile_source("warm") so every compile they
+# trigger — however deep in the fuser — lands on the warm side of the
+# warm-vs-demand split without threading a parameter through the stack.
+_compile_source = threading.local()
+
+
+@contextmanager
+def compile_source(source: str):
+    """Scope within which compiles are attributed to ``source``
+    ("warm" for warm-pool pre-compiles; the default is "demand")."""
+    prev = getattr(_compile_source, "value", None)
+    _compile_source.value = source
+    try:
+        yield
+    finally:
+        _compile_source.value = prev
+
+
+def current_compile_source() -> str:
+    return getattr(_compile_source, "value", None) or "demand"
+
+
+def record_compile(fp: str, seconds: float, label: Optional[str] = None,
+                   source: Optional[str] = None,
+                   compile_class=None) -> None:
+    """One compile (jit trace + lower + XLA compile wall) for a kernel.
+
+    ``source`` defaults to the ambient :func:`compile_source` scope;
+    ``"warm"`` compiles are additionally split out so diagnostics can
+    show how much compile wall the warm pool pre-paid.  Emits a
+    ``compile`` trace event (source-tagged) when tracing is on so
+    ``scripts/trace_report.py`` can report the split offline."""
+    src = source or current_compile_source()
+    with _lock:
+        e = _entry(fp, label)
+        e.compiles += 1
+        e.compile_s += seconds
+        if src == "warm":
+            e.warm_compiles += 1
+            e.warm_compile_s += seconds
+        if compile_class is not None:
+            e.compile_class = tuple(compile_class)
+    if _events.trace_enabled():
+        _events.emit({
+            "type": "compile",
+            "fingerprint": fp,
+            "seconds": round(seconds, 6),
+            "source": src,
+        })
+
+
+def record_class(fp: str, compile_class, pad_waste: int,
+                 label: Optional[str] = None) -> None:
+    """Record a flush's compile-class decision on its kernel entry
+    (token + cumulative pad-waste bytes, the cost side of bucketing)."""
+    with _lock:
+        e = _entry(fp, label)
+        e.compile_class = tuple(compile_class)
+        e.pad_waste += int(pad_waste)
+
+
 def record_cache(fp: str, kind: str, label: Optional[str] = None) -> None:
     """One compile-cache interaction: ``kind`` in hit|miss|evict."""
     with _lock:
@@ -382,7 +461,9 @@ def record_execute(fp: str, label: str, instrs: int, rung: str,
     accumulates a per-tenant execution count on the entry.  ``backend``
     (a lowering backend name, ``xla``/``pallas``) additionally records
     the sample in that backend's slice — the per-fingerprint evidence
-    ``core/autotune.py`` races on."""
+    ``core/autotune.py`` races on.  Compiles inherit the ambient
+    :func:`compile_source` scope ("warm" inside warm-pool thunks)."""
+    src = current_compile_source() if is_new else None
     with _lock:
         e = _entry(fp, label, instrs, donated)
         e.instrs = instrs or e.instrs
@@ -395,6 +476,9 @@ def record_execute(fp: str, label: str, instrs: int, rung: str,
         if is_new:
             e.compiles += 1
             e.compile_s += seconds
+            if src == "warm":
+                e.warm_compiles += 1
+                e.warm_compile_s += seconds
         else:
             e.exec.add(seconds)
             if sync_seconds is not None:
@@ -408,6 +492,13 @@ def record_execute(fp: str, label: str, instrs: int, rung: str,
                 b.compile_s += seconds
             else:
                 b.exec.add(seconds)
+    if is_new and _events.trace_enabled():
+        _events.emit({
+            "type": "compile",
+            "fingerprint": fp,
+            "seconds": round(seconds, 6),
+            "source": src,
+        })
 
 
 def record_backend_fallback(fp: str, backend: str, err: str,

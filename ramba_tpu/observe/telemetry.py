@@ -388,6 +388,42 @@ def _autotune_series(fams: _Families) -> None:
                  {"backend": backend})
 
 
+def _compile_series(fams: _Families) -> None:
+    from ramba_tpu.compile import classes as _classes
+    from ramba_tpu.compile import persist as _persist
+
+    csnap = _classes.snapshot()
+    psnap = _persist.snapshot()
+    if (csnap.get("mode") == "off" and not csnap.get("planned")
+            and not csnap.get("bailouts") and not psnap.get("armed")
+            and not psnap.get("hits") and not psnap.get("misses")):
+        return  # feature unused: keep the exposition quiet
+    fams.add("ramba_compile_class_planned_total", "counter",
+             csnap.get("planned", 0))
+    fams.add("ramba_compile_class_padded_total", "counter",
+             csnap.get("padded", 0))
+    fams.add("ramba_compile_bucket_bailout_total", "counter",
+             csnap.get("bailouts", 0))
+    fams.add("ramba_compile_class_pad_bytes_total", "counter",
+             csnap.get("pad_bytes", 0))
+    fams.add("ramba_compile_class_pad_waste_frac", "gauge",
+             csnap.get("pad_waste_frac", 0.0))
+    fams.add("ramba_compile_persist_armed", "gauge",
+             1 if psnap.get("armed") else 0)
+    fams.add("ramba_compile_persist_hits_total", "counter",
+             psnap.get("hits", 0))
+    fams.add("ramba_compile_persist_misses_total", "counter",
+             psnap.get("misses", 0))
+    fams.add("ramba_compile_persist_corrupt_total", "counter",
+             psnap.get("corrupt", 0))
+    fams.add("ramba_compile_persist_stores_total", "counter",
+             psnap.get("stores", 0))
+    fams.add("ramba_compile_persist_bytes_read_total", "counter",
+             psnap.get("bytes_read", 0))
+    fams.add("ramba_compile_persist_bytes_written_total", "counter",
+             psnap.get("bytes_written", 0))
+
+
 def _elastic_series(fams: _Families) -> None:
     from ramba_tpu.resilience import elastic as _elastic
 
@@ -422,6 +458,10 @@ def render() -> str:
         _autotune_series(fams)
     except Exception:
         pass  # autotuner not imported/available: skip its families
+    try:
+        _compile_series(fams)
+    except Exception:
+        pass  # compile classes / persist cache unused: skip
     try:
         _elastic_series(fams)
     except Exception:

@@ -72,7 +72,15 @@ three flushes and the ``serve:shed`` agreement round sheds them on
 every rank (the coherent-shedding chaos leg); ``serve:hedge`` is
 checked only by the *primary* attempt of a hedged dispatch, so
 ``serve:hedge:delay:ms=200`` slows the primary deterministically and
-seeds a hedge race without perturbing results.
+seeds a hedge race without perturbing results.  The compile-classes
+subsystem (``ramba_tpu/compile/``) adds ``compile:bucket`` (like
+``donate_census``, it does not fail the flush: it replaces the flush's
+shape-bucket plan with one that skipped the op-safety proof, the
+seeded violation the RAMBA_VERIFY compile-class rule exists to catch)
+and ``compile:persist`` (checked inside every persistent-executable
+cache lookup; an injected fault clobbers the on-disk entry with junk
+bytes first, so the corruption-tolerance path — evict + recompile,
+never raise — is exercised deterministically).
 
 Site names may themselves contain colons (``reshard:plan``,
 ``reshard:stage``): the site/mode boundary in a spec is the FIRST

@@ -37,6 +37,7 @@ from typing import Optional
 
 from ramba_tpu.core import fuser as _fuser
 from ramba_tpu.observe import events as _events
+from ramba_tpu.observe import ledger as _ledger
 from ramba_tpu.observe import registry as _registry
 from ramba_tpu.observe import slo as _slo
 from ramba_tpu.resilience import coherence as _coherence
@@ -332,8 +333,12 @@ class CompilePipeline:
         for ticket in group:
             if isinstance(ticket, WarmTicket):
                 # Warm tasks carry a bare thunk, not prepared flush work.
+                # The compile_source scope tags every compile the thunk
+                # triggers as "warm" in the ledger — the warm-vs-demand
+                # split diagnostics and trace_report surface.
                 try:
-                    ticket.thunk()
+                    with _ledger.compile_source("warm"):
+                        ticket.thunk()
                 except BaseException as e:  # noqa: BLE001 — captured, not fatal
                     _registry.inc("serve.warm_failed")
                     self._finish(ticket, error=e)

@@ -32,3 +32,5 @@ echo "=== leg 13: effect-certified result memoization (2-rank lockstep cache) ==
 python scripts/two_process_suite.py --memo-leg
 echo "=== leg 14: coherent load shedding (2-rank, rank-skewed serve:admit faults) ==="
 python scripts/two_process_suite.py --overload-leg
+echo "=== leg 15: compile classes + persistent warm start (2-rank lockstep buckets, AOT cache) ==="
+python scripts/two_process_suite.py --warmstart-leg

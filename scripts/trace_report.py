@@ -126,6 +126,25 @@ def report(path: str, events: list, top: int = 10, file=None) -> None:
     if memo_hits:
         line += f"  memo hits: {memo_hits}"
     print(line, file=file)
+    # compile-class + warm-pool attribution (PR-14): `compile` events are
+    # source-tagged by the ledger; bucketed spans carry compile_class
+    compiles = [e for e in events if e.get("type") == "compile"]
+    bucketed = [f for f in flushes if f.get("compile_class")]
+    if compiles or bucketed:
+        warm = [e for e in compiles if e.get("source") == "warm"]
+        warm_s = sum(e.get("seconds", 0.0) for e in warm)
+        all_s = sum(e.get("seconds", 0.0) for e in compiles)
+        line = (f"compiles: {len(compiles)} "
+                f"({len(warm)} warm {warm_s:.4f}s / "
+                f"{len(compiles) - len(warm)} demand "
+                f"{all_s - warm_s:.4f}s)")
+        if bucketed:
+            waste = sum(f.get("pad_waste_bytes", 0) for f in bucketed)
+            classes = sorted({tuple(f["compile_class"]) for f in bucketed})
+            line += (f"  bucketed flushes: {len(bucketed)}"
+                     f" classes: {len(classes)}"
+                     f" pad waste: {_fmt_bytes(waste)}")
+        print(line, file=file)
     cse = [e for e in events if e.get("type") == "cse_merge"]
     if memo_hits or cse:
         rejected = sum(1 for e in events

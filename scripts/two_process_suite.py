@@ -119,6 +119,19 @@ post-flush gathers.  The runner asserts both ranks print the identical
 canonical hash, the identical hit/insert counts, the correct value,
 and that each per-rank trace carries memo-served flush spans
 (``cache == "memo"``).
+
+``--warmstart-leg`` runs the compile-class / warm-start acceptance leg
+(PR 14): two phases of two ranks each, sharing per-rank ``RAMBA_CACHE``
+directories across phases.  Under ``RAMBA_COMPILE_CLASSES=pow2`` the
+bucket decision is a pure function of (program, shapes, policy), so
+both SPMD ranks must pick the IDENTICAL compile class per fingerprint
+— skewed classes would compile different executables and desync the
+collective schedule.  The cold phase populates each rank's persistent
+cache (``persist.save_topk``); the warm phase replays the same shapes
+and must hit the AOT lane in LOCKSTEP (equal, nonzero persist-hit
+counts on both ranks).  The runner compares the per-rank class-decision
+tables within and across phases and the persist hit counts across
+ranks.
 """
 
 from __future__ import annotations
@@ -310,6 +323,63 @@ if cache:
         assert table['decisions'][fp]['backend'] == b, (fp, table)
 print('AUTOTUNE_LEG_DECISIONS rank=%d %s'
       % (rank, ','.join('%s=%s' % kv for kv in sorted(dec.items()))))
+"""
+
+
+# SPMD workload for the warmstart leg: each rank forms the process
+# group, arms the persistent cache on its own RAMBA_CACHE dir, and
+# drives the same elementwise chain across four leading extents under
+# RAMBA_COMPILE_CLASSES=pow2 (small enough to stay replicated, so the
+# eager pad/slice wrapper touches only fully-addressable buffers).  The
+# cold phase additionally serializes AOT executables; the warm phase
+# must hit them.  Markers carry the per-fingerprint class-decision
+# table, the persist hit count, and the compile totals for the runner
+# to compare across ranks and phases.  argv: <rank> <coordinator>
+# <phase: cold|warm>.
+_WARMSTART_WORKLOAD = """
+import sys
+import numpy as np
+rank, coord, phase = int(sys.argv[1]), sys.argv[2], sys.argv[3]
+from ramba_tpu.parallel import distributed
+distributed.initialize(coordinator_address=coord, num_processes=2,
+                       process_id=rank)
+import jax
+assert jax.process_count() == 2, jax.process_count()
+import ramba_tpu as rt
+from ramba_tpu import common
+from ramba_tpu.compile import classes, persist
+from ramba_tpu.observe import ledger
+assert classes.enabled(), 'RAMBA_COMPILE_CLASSES not armed'
+common.setup_persistent_cache()
+persist.reconfigure()
+assert persist.armed(), persist.snapshot()
+for n in (3, 5, 9, 12):
+    x = rt.array(np.arange(n * 8, dtype=np.float32).reshape(n, 8))
+    y = x * 2.0 + 1.0
+    rt.sync()
+    got = float(rt.sum(y))
+    exp = float(np.sum(np.arange(n * 8, dtype=np.float32)
+                       .reshape(n, 8) * 2.0 + 1.0))
+    assert abs(got - exp) <= 1e-4 * abs(exp), (n, got, exp)
+snap = classes.snapshot()
+assert snap['planned'] >= 4, snap
+dec = {fp: tok for fp, tok in classes.decisions().items()
+       if tok is not None}
+assert dec, classes.decisions()
+if phase == 'cold':
+    rep = persist.save_topk(8)
+    assert rep['stored'] + rep['skipped'] >= 1, rep
+p = persist.snapshot()
+if phase == 'warm':
+    assert p['hits'] >= 1, p
+ks = ledger.snapshot()['kernels'].values()
+compiles = sum(k['compiles'] for k in ks)
+compile_s = sum(k['compile_s'] for k in ks)
+table = ','.join('%s=%s:%s' % (fp, tok[0], tok[1])
+                 for fp, tok in sorted(dec.items()))
+print('WARMSTART_LEG rank=%d phase=%s classes=%s persist_hits=%d '
+      'compiles=%d compile_s=%.4f'
+      % (rank, phase, table, p['hits'], compiles, compile_s))
 """
 
 
@@ -1318,6 +1388,111 @@ def run_memo_leg() -> int:
     return 0 if ok else 1
 
 
+def run_warmstart_leg() -> int:
+    """Cold phase + warm phase of two SPMD ranks each, sharing per-rank
+    RAMBA_CACHE dirs across phases.  Both ranks must pick IDENTICAL
+    compile classes per fingerprint (the decision is pure in program
+    structure, shapes, and policy), and the warm phase must hit the
+    pre-seeded persist cache in lockstep (equal, nonzero hit counts)."""
+    basetemp = tempfile.mkdtemp(prefix="ramba_2proc_warmstart_")
+    budget = float(os.environ.get("RAMBA_TEST_PROCS_TIMEOUT", "600"))
+    ok = True
+    # markers[phase][rank] -> {"classes": str, "hits": int, ...}
+    markers: dict = {}
+
+    for phase in ("cold", "warm"):
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+        procs, logs = [], []
+        for rank in range(2):
+            env = dict(os.environ)
+            env["PYTHONPATH"] = REPO
+            for k in ("RAMBA_TEST_PROCS", "RAMBA_TEST_PROC_ID",
+                      "RAMBA_TEST_COORD", "RAMBA_TEST_SHARED_TMP",
+                      "RAMBA_PROFILE_DIR", "RAMBA_FAULTS",
+                      "RAMBA_HBM_BUDGET", "RAMBA_MEMO"):
+                env.pop(k, None)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+            env["RAMBA_COMPILE_CLASSES"] = "pow2"
+            # per-rank cache dir, SHARED across phases: the warm phase
+            # reads what its own rank's cold phase stored
+            env["RAMBA_CACHE"] = os.path.join(basetemp, f"cache.rank{rank}")
+            log = open(os.path.join(basetemp, f"{phase}.rank{rank}.log"),
+                       "w")
+            logs.append(log)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", _WARMSTART_WORKLOAD, str(rank),
+                 f"localhost:{port}", phase],
+                env=env, stdout=log, stderr=subprocess.STDOUT, cwd=REPO,
+            ))
+        deadline = time.time() + budget
+        rcs = [None, None]
+        try:
+            for i, p in enumerate(procs):
+                left = max(5.0, deadline - time.time())
+                try:
+                    rcs[i] = p.wait(timeout=left)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    rcs[i] = -9
+        finally:
+            for log in logs:
+                log.close()
+        phase_ok = all(rc == 0 for rc in rcs)
+        markers[phase] = [None, None]
+        for rank in range(2):
+            path = os.path.join(basetemp, f"{phase}.rank{rank}.log")
+            with open(path) as f:
+                tail = f.read().splitlines()
+            prefix = f"WARMSTART_LEG rank={rank} phase={phase} "
+            for line in tail:
+                if line.startswith(prefix):
+                    fields = dict(
+                        kv.split("=", 1)
+                        for kv in line[len(prefix):].split(" "))
+                    markers[phase][rank] = fields
+            if markers[phase][rank] is None:
+                phase_ok = False
+            print(f"--- warmstart {phase} rank {rank} rc={rcs[rank]} "
+                  f"({path}) ---")
+            print("\n".join(tail[-(3 if phase_ok else 40):]))
+        ok = ok and phase_ok
+        if not phase_ok:
+            break
+
+    if ok:
+        for phase in ("cold", "warm"):
+            r0, r1 = markers[phase]
+            if r0["classes"] != r1["classes"]:
+                print(f"warmstart leg: FAIL ({phase} class skew: "
+                      f"r0={r0['classes']} r1={r1['classes']})")
+                ok = False
+        if ok and markers["cold"][0]["classes"] != \
+                markers["warm"][0]["classes"]:
+            print("warmstart leg: FAIL (classes drifted across phases)")
+            ok = False
+        if ok:
+            h0 = int(markers["warm"][0]["persist_hits"])
+            h1 = int(markers["warm"][1]["persist_hits"])
+            if h0 != h1 or h0 < 1:
+                print(f"warmstart leg: FAIL (persist hits not lockstep: "
+                      f"r0={h0} r1={h1})")
+                ok = False
+            else:
+                print(f"warmstart leg: lockstep classes "
+                      f"({markers['warm'][0]['classes']}), "
+                      f"{h0} persist hits per rank, warm compiles="
+                      f"{markers['warm'][0]['compiles']} "
+                      f"(cold={markers['cold'][0]['compiles']})")
+
+    print(f"two-process warmstart leg: {'OK' if ok else 'FAIL'}")
+    if ok:
+        shutil.rmtree(basetemp, ignore_errors=True)
+    return 0 if ok else 1
+
+
 def run_autotune_leg() -> int:
     """Two ranks under RAMBA_AUTOTUNE=race; both must latch the SAME
     backend per kernel fingerprint (selection is ledger-count-driven and
@@ -1983,6 +2158,8 @@ def main() -> int:
         return run_autotune_leg()
     if "--memo-leg" in sys.argv[1:]:
         return run_memo_leg()
+    if "--warmstart-leg" in sys.argv[1:]:
+        return run_warmstart_leg()
     if "--overload-leg" in sys.argv[1:]:
         return run_overload_leg()
     pytest_args = sys.argv[1:] or ["tests/"]

@@ -345,8 +345,10 @@ class TestPersistentCache:
         from ramba_tpu import common
 
         cache_dir = str(tmp_path / "xla_cache")
-        monkeypatch.setattr(common, "cache_env", cache_dir)
-        assert common.setup_persistent_cache() == cache_dir
+        monkeypatch.setenv("RAMBA_CACHE", cache_dir)
+        status = common.setup_persistent_cache()
+        assert status.path == cache_dir and status.ok, status
+        assert status.enabled
         assert os.path.isdir(cache_dir)
         try:
             # a fresh program structure so the executable is actually compiled
@@ -361,10 +363,12 @@ class TestPersistentCache:
     def test_disabled_by_default(self, monkeypatch):
         from ramba_tpu import common
 
+        monkeypatch.delenv("RAMBA_CACHE", raising=False)
         monkeypatch.setattr(common, "cache_env", None)
-        assert common.setup_persistent_cache() is None
-        monkeypatch.setattr(common, "cache_env", "0")
-        assert common.setup_persistent_cache() is None
+        status = common.setup_persistent_cache()
+        assert status.path is None and status.ok and not status.enabled
+        monkeypatch.setenv("RAMBA_CACHE", "0")
+        assert common.setup_persistent_cache().path is None
 
 
 class TestApiParity:
