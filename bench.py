@@ -942,6 +942,50 @@ def _bench_compile(rt, platform):
     return out
 
 
+def _bench_attribution(rt, platform):
+    """Attribution rollup of everything this bench ran (must be the LAST
+    section): stage-seconds waterfall + unattributed residual across all
+    flushes, per-fingerprint roofline rows (achieved fraction of peak and
+    bandwidth/compute-bound class), and the sentinel tally.  Also stamps
+    ``device_kind`` and the resolved peak table at top level so
+    BENCH_TPU_LAST.json captures stay comparable across hardware — and
+    two perf_diff-gated scalars: ``attrib_unattributed_frac`` (lower is
+    better: the waterfall explains the wall) and ``roofline_peak_frac``
+    (higher is better: the best kernel's fraction of silicon peak)."""
+    from ramba_tpu.observe import attrib
+
+    out = {}
+    rep = attrib.attribution_report()
+    if not rep:
+        return out
+    out["device_kind"] = rep["device_kind"]
+    out["peaks"] = rep["peaks"]
+    roofs = rep["rooflines"]
+    out["attribution"] = {
+        "flushes": rep["flushes"],
+        "stage_seconds": rep["stage_seconds"],
+        "unattributed_s": rep["unattributed_s"],
+        "kernels": {
+            fp: {
+                "label": r["label"],
+                "bound": r["bound"],
+                "frac_of_peak": r["frac_of_peak"],
+                "achieved_gb_per_s": r["achieved_gb_per_s"],
+                "achieved_tflops": r["achieved_tflops"],
+                "device_p50_s": r["device_p50_s"],
+                "device_time_source": r["device_time_source"],
+            }
+            for fp, r in roofs.items()
+        },
+        "sentinel": rep["sentinel"],
+    }
+    out["attrib_unattributed_frac"] = rep["unattributed_frac"]
+    if roofs:
+        out["roofline_peak_frac"] = max(
+            r["frac_of_peak"] for r in roofs.values())
+    return out
+
+
 def _bench_dispatch_floor(rt):
     """Measured per-dispatch round-trip cost (flush + scalar fetch of a
     tiny computation): on a tunneled chip this floor dominates small
@@ -1130,6 +1174,11 @@ def main():
             out.update(_bench_compile(rt, platform))
         except Exception:  # noqa: BLE001
             out["compile_error"] = traceback.format_exc(limit=2)[-300:]
+
+        try:
+            out.update(_bench_attribution(rt, platform))
+        except Exception:  # noqa: BLE001
+            out["attribution_error"] = traceback.format_exc(limit=2)[-300:]
     except Exception:  # noqa: BLE001 - even import/backend failure emits JSON
         out["error"] = traceback.format_exc(limit=3)[-400:]
 

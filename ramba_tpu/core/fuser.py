@@ -57,6 +57,7 @@ from ramba_tpu.compile import classes as _classes
 from ramba_tpu.compile import persist as _persist
 from ramba_tpu.core import memo as _memo
 from ramba_tpu.core.expr import Const, Expr, Node, Scalar, OPS
+from ramba_tpu.observe import attrib as _attrib
 from ramba_tpu.observe import events as _events
 from ramba_tpu.observe import ledger as _ledger
 from ramba_tpu.observe import profile as _profile
@@ -908,6 +909,10 @@ def _execute_compiled(fn, program: _Program, leaf_vals, is_new: bool,
     execution ran on), and — when ``span`` is given — a per-call child
     record in the flush span.  Used by both the monolithic and segmented
     flush paths so the two can never drift."""
+    # Attribution clock starts at call entry — BEFORE the fault hooks — so
+    # an injected execute delay lands in the sentinel's device window
+    # exactly like a real device slowdown.
+    t_call = time.perf_counter()
     _faults.check("execute", instrs=len(program.instrs))
     _faults.check("oom", instrs=len(program.instrs))
     if is_new and _ledger.cost_enabled() and fp is not None:
@@ -940,11 +945,19 @@ def _execute_compiled(fn, program: _Program, leaf_vals, is_new: bool,
         outs = fn(*leaf_vals)
     dt = time.perf_counter() - t0
     sync_dt = None
-    if _ledger.sync_timing():
-        # RAMBA_PERF=sync: a second, device-synchronized sample.  dt above
-        # stays the dispatch-time measurement every existing consumer sees.
-        jax.block_until_ready(outs)
-        sync_dt = time.perf_counter() - t0
+    fence_dt = None
+    if _attrib.fence_enabled() or _ledger.sync_timing():
+        # Always-on cheap device fence: dt above stays the dispatch-time
+        # measurement every existing consumer sees; the fence window is
+        # the on-device tail the stage ledger files as device_execute.
+        try:
+            jax.block_until_ready(outs)
+            fence_dt = time.perf_counter() - t0 - dt
+        except Exception:
+            fence_dt = None
+        if fence_dt is not None and _ledger.sync_timing():
+            # RAMBA_PERF=sync: a second, device-synchronized sample.
+            sync_dt = dt + fence_dt
     if is_new:
         # jax.jit compiles lazily: the first call pays trace+lower+XLA
         # compile.  Attribute it separately so per-program execution times
@@ -962,7 +975,21 @@ def _execute_compiled(fn, program: _Program, leaf_vals, is_new: bool,
             donated=donated, sync_seconds=sync_dt,
             tenant=current_tenant(), backend=backend,
         )
+        if fence_dt is not None and not is_new:
+            # steady-state fenced window (entry through fence) feeds the
+            # roofline device-time estimate and the drift sentinel
+            _attrib.record_device(fp, _program_label(program),
+                                  time.perf_counter() - t_call,
+                                  backend=backend)
     if span is not None:
+        if is_new:
+            # first call pays trace+lower+XLA compile; the pre-call
+            # prelude (cost probe, show_code lowering) bills here too
+            _attrib.add_stage(span, "compile", (t0 - t_call) + dt)
+        else:
+            _attrib.add_stage(span, "dispatch", (t0 - t_call) + dt)
+        if fence_dt is not None:
+            _attrib.add_stage(span, "device_execute", fence_dt)
         call = {
             "label": _program_label(program),
             "cache": "miss" if is_new else "hit",
@@ -1545,6 +1572,7 @@ def _flush_prepare(stream: FlushStream, roots: list,
             "linearize_s": round(linearize_s, 6),
             "rewrite_fires": rewrite_fires,
             "calls": [],
+            "stages": {},
         }
         if stream is not _default_stream:
             span["stream"] = stream.name
@@ -1640,6 +1668,7 @@ def _flush_prepare(stream: FlushStream, roots: list,
             _quarantine(work, e)
         _release(work)
         raise
+    t_verify = time.perf_counter()
     try:
         work.skip_fused = _verify_if_enabled(
             program, leaves, vexprs, donate_key, span, label,
@@ -1649,6 +1678,8 @@ def _flush_prepare(stream: FlushStream, roots: list,
         _quarantine(work, e)
         _release(work)
         raise
+    if os.environ.get("RAMBA_VERIFY"):  # keep the stage ledger sparse
+        _attrib.add_stage(span, "verify", time.perf_counter() - t_verify)
     if work.skip_fused:
         # a verifier-distrusted flush must not populate (or consult) the
         # result cache: whatever routed it down the ladder may be the
@@ -1671,6 +1702,13 @@ def _flush_prepare(stream: FlushStream, roots: list,
         work.deadline = _overload.mint_deadline(stream.deadline_ms)
         if work.deadline is not None:
             span["deadline_ms"] = work.deadline.budget_ms
+    # Everything on the caller thread so far (linearize, fuse, leaf
+    # gather, donation census, memo/class planning) minus the verifier,
+    # which has its own stage.
+    _attrib.add_stage(
+        span, "prepare",
+        (time.perf_counter() - work.t_flush)
+        - span["stages"].get("verify", 0.0))
     return work
 
 
@@ -1727,6 +1765,7 @@ def _finish_memo_hit(work: "_FlushWork") -> list:
     span["memo_hit"] = True
     span["out_bytes"] = sum(_nbytes(v) for v in outs)
     span["wall_s"] = round(time.perf_counter() - work.t_flush, 6)
+    _attrib.finalize_span(span, fp=work.fingerprint)
     _events.emit(span)
     _slo.observe_span(span)
     _elastic.note_progress("flush")
@@ -1752,7 +1791,13 @@ def _flush_dispatch_traced(work: "_FlushWork", *, coalesced: int = 0) -> list:
     stream, span, program = work.stream, work.span, work.program
     roots, label = work.roots, work.label
     if work.enqueued_at is not None:
-        span["queue_s"] = round(time.perf_counter() - work.enqueued_at, 6)
+        queue_s = time.perf_counter() - work.enqueued_at
+        span["queue_s"] = round(queue_s, 6)
+        # queue_s spans submit -> this dispatch; the pipeline already
+        # billed the group-pop -> this-ticket slice as coalesce
+        _attrib.add_stage(
+            span, "queue_wait",
+            queue_s - span.get("stages", {}).get("coalesce", 0.0))
     if coalesced > 1:
         span["coalesced"] = coalesced
     # Overload shed verdict — before admission, compile, and execution,
@@ -1789,9 +1834,11 @@ def _flush_dispatch_traced(work: "_FlushWork", *, coalesced: int = 0) -> list:
     try:
         if work.detached:
             _revalidate_donation(work)
+        t_admit = time.perf_counter()
         route_chunked = _memory.admit(program, leaf_vals, work.donate_key,
                                       span, tenant=stream.tenant,
                                       quota=stream.quota_bytes)
+        _attrib.add_stage(span, "admit", time.perf_counter() - t_admit)
         # Hedged dispatch: when RAMBA_HEDGE_FACTOR is set and the program
         # is effect-certified pure with no donation, a dispatch running
         # past factor x its rolling p95 races a second attempt; the first
@@ -1803,7 +1850,11 @@ def _flush_dispatch_traced(work: "_FlushWork", *, coalesced: int = 0) -> list:
 
             hedge_s = _overload.hedge_threshold(label, program,
                                                 work.donate_key)
-        with _profile.annotation("ramba_flush:" + label):
+        _stages_pre = sum(span["stages"].get(k, 0.0) for k in
+                          ("compile", "dispatch", "device_execute"))
+        t_ladder = time.perf_counter()
+        with _profile.flush_annotation("ramba_flush:" + label,
+                                       trace_id=span.get("trace_id")):
             with warnings.catch_warnings():
                 warnings.filterwarnings("ignore", message=".*[Dd]onat.*")
                 if hedge_s is not None:
@@ -1828,6 +1879,15 @@ def _flush_dispatch_traced(work: "_FlushWork", *, coalesced: int = 0) -> list:
         raise
     finally:
         _release(work)
+    t_writeback = time.perf_counter()
+    # Host-side ladder residual — jit-cache lookup, guard/retry control,
+    # donation prep, pin release — is dispatch-path overhead: bill the
+    # slice of the ladder window the per-call stamps did not cover.
+    _attrib.add_stage(
+        span, "dispatch",
+        (t_writeback - t_ladder)
+        - (sum(span["stages"].get(k, 0.0) for k in
+               ("compile", "dispatch", "device_execute")) - _stages_pre))
     if rung != "fused":
         span["degraded"] = rung
     with _stats_lock:
@@ -1877,6 +1937,8 @@ def _flush_dispatch_traced(work: "_FlushWork", *, coalesced: int = 0) -> list:
     )
     span["out_bytes"] = sum(_nbytes(v) for v in outs)
     span["wall_s"] = round(time.perf_counter() - work.t_flush, 6)
+    _attrib.add_stage(span, "write_back", time.perf_counter() - t_writeback)
+    _attrib.finalize_span(span, fp=work.fingerprint)
     _events.emit(span)
     # Slow-flush sentinel: compares this flush against the program's own
     # rolling history and emits at most one slow_flush event (after the

@@ -86,6 +86,14 @@ def perf_report() -> dict:
         snap.update(_compile_section(snap))
     except Exception:
         pass
+    try:
+        from ramba_tpu.observe import attrib as _attrib
+
+        arep = _attrib.attribution_report()
+        if arep:
+            snap["attribution"] = arep
+    except Exception:
+        pass
     return snap
 
 
@@ -300,6 +308,32 @@ def report(file=None) -> None:
             f" demand={t['demand']} ({t['demand_s']:.4f}s)",
             file=file,
         )
+    attr = perf.get("attribution")
+    if attr:
+        print("-- attribution --", file=file)
+        stages = " ".join(f"{k}={v:.4f}s"
+                          for k, v in attr["stage_seconds"].items())
+        print(f"  flushes={attr['flushes']} {stages}"
+              f" unattributed={attr['unattributed_s']:.4f}s"
+              f" ({attr['unattributed_frac']:.1%})", file=file)
+        print(f"  device_kind={attr['device_kind'] or '?'}"
+              f" peaks={attr['peaks']['peak_gbps']:g}GB/s"
+              f"/{attr['peaks']['peak_tflops']:g}TFLOPs"
+              f" ({attr['peaks']['source']})", file=file)
+        roofs = sorted(attr["rooflines"].items(),
+                       key=lambda kv: kv[1]["frac_of_peak"], reverse=True)[:8]
+        for fp, r in roofs:
+            print(f"  {fp} {r['label']:<18s} {r['bound']:<9s}"
+                  f" peak={r['frac_of_peak']:.2%}"
+                  f" bw={r['achieved_gb_per_s']:g}GB/s"
+                  f" fl={r['achieved_tflops']:g}TFLOPs"
+                  f" dev_p50={r['device_p50_s']:.6f}s"
+                  f" ({r['device_time_source']})", file=file)
+        sen = attr["sentinel"]
+        if sen["regressions"] or sen["baselines"]:
+            print(f"  sentinel baselines={sen['baselines']}"
+                  f" regressions={sen['regressions']}"
+                  f" factor={sen['drift_factor']:g}", file=file)
     memo = memo_report()
     if memo["enabled"] or memo["inserts"] or memo["hits"]:
         print("-- result memo --", file=file)

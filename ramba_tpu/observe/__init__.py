@@ -22,9 +22,18 @@ Environment variables:
   execution timing.  The ledger itself is always on.
 * ``RAMBA_SLOW_FLUSH_FACTOR`` / ``RAMBA_SLOW_FLUSH_MIN_SAMPLES`` /
   ``RAMBA_PERF_WINDOW`` — slow-flush sentinel tuning (see ``ledger``).
+* ``RAMBA_ATTRIB=off`` — disable the always-on ``block_until_ready``
+  device fence the stage waterfalls and rooflines use (``attrib``).
+* ``RAMBA_PROFILE=deep`` — flush TraceAnnotations carry the span's
+  trace id, joining profiler timelines to RAMBA_TRACE spans.
+* ``RAMBA_PEAKS_JSON`` — hardware-peak table override (inline JSON or a
+  file path) for the roofline ledger.
+* ``RAMBA_BASELINE_DIR`` / ``RAMBA_PERF_DRIFT_FACTOR`` /
+  ``RAMBA_PERF_DRIFT_MIN_SAMPLES`` — perf-regression sentinel: persisted
+  per-kernel device-time baselines and the drift trip point.
 
 Public read API lives in ``ramba_tpu.diagnostics`` (``perf_report()`` for
-the ledger).
+the ledger, including the ``attribution`` section).
 """
 
-from ramba_tpu.observe import events, health, ledger, profile, registry  # noqa: F401
+from ramba_tpu.observe import attrib, events, health, ledger, profile, registry  # noqa: F401

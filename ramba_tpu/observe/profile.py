@@ -7,6 +7,12 @@ label — so the Perfetto/TensorBoard timeline shows which ramba program each
 XLA module execution belongs to.  This supersedes the ad-hoc
 ``RAMBA_TIMING>=2`` annotation previously buried in core/fuser.py (which
 still works: annotations engage when EITHER gate is on).
+
+``RAMBA_PROFILE=deep`` additionally joins the attribution plane
+(observe/attrib.py) to XLA profiler traces: every flush dispatch runs
+inside a ``TraceAnnotation`` that carries the span's trace id, so a
+Perfetto timeline row can be matched back to the exact flush span (and
+its stage waterfall) in the RAMBA_TRACE event stream.
 """
 
 from __future__ import annotations
@@ -16,11 +22,23 @@ import contextlib
 import os
 
 _DIR = os.environ.get("RAMBA_PROFILE_DIR") or None
+_deep = (os.environ.get("RAMBA_PROFILE") or "").lower() == "deep"
 _started = False
 
 
 def enabled() -> bool:
     return _DIR is not None
+
+
+def deep() -> bool:
+    return _deep
+
+
+def reconfigure() -> None:
+    """Re-read RAMBA_PROFILE_DIR / RAMBA_PROFILE (tests)."""
+    global _DIR, _deep
+    _DIR = os.environ.get("RAMBA_PROFILE_DIR") or None
+    _deep = (os.environ.get("RAMBA_PROFILE") or "").lower() == "deep"
 
 
 def ensure_started() -> None:
@@ -54,8 +72,23 @@ def annotation(label: str):
     a free nullcontext otherwise — safe on the per-flush hot path."""
     from ramba_tpu import common
 
-    if _DIR is None and common.timing_level <= 1:
+    if _DIR is None and common.timing_level <= 1 and not _deep:
         return contextlib.nullcontext()
     import jax.profiler as _prof
 
+    return _prof.TraceAnnotation(label)
+
+
+def flush_annotation(label: str, trace_id=None):
+    """Flush-dispatch annotation.  Under ``RAMBA_PROFILE=deep`` the
+    annotation carries the flush span's trace id as a TraceMe argument,
+    joining profiler timeline rows to RAMBA_TRACE spans; otherwise it
+    degrades to :func:`annotation` (free nullcontext when nothing is
+    profiling)."""
+    if not _deep:
+        return annotation(label)
+    import jax.profiler as _prof
+
+    if trace_id is not None:
+        return _prof.TraceAnnotation(label, trace_id=trace_id)
     return _prof.TraceAnnotation(label)

@@ -110,7 +110,8 @@ _events.set_context_provider(_context_fields)
 # ---------------------------------------------------------------------------
 
 #: Event types that constitute an incident (each occurrence = one dump).
-FLIGHT_TRIGGERS = ("slow_flush", "stall", "slo_breach", "flush_error")
+FLIGHT_TRIGGERS = ("slow_flush", "stall", "slo_breach", "flush_error",
+                   "perf_regression")
 
 _flight_lock = threading.Lock()
 _flight_dumps = 0
@@ -394,10 +395,21 @@ def _compile_series(fams: _Families) -> None:
 
     csnap = _classes.snapshot()
     psnap = _persist.snapshot()
+    # jit-cache hit rate is meaningful with or without compile classes —
+    # exported ahead of the quiet-when-unused cut below
+    hits = _registry.get("fuser.cache_hit")
+    misses = _registry.get("fuser.cache_miss")
+    if hits + misses:
+        fams.add("ramba_compile_hit_rate", "gauge",
+                 round(hits / (hits + misses), 4))
     if (csnap.get("mode") == "off" and not csnap.get("planned")
             and not csnap.get("bailouts") and not psnap.get("armed")
             and not psnap.get("hits") and not psnap.get("misses")):
         return  # feature unused: keep the exposition quiet
+    fams.add("ramba_compile_call_fallbacks_total", "counter",
+             psnap.get("call_fallbacks", 0))
+    fams.add("ramba_compile_bucket_pad_waste_bytes", "gauge",
+             csnap.get("pad_bytes", 0))
     fams.add("ramba_compile_class_planned_total", "counter",
              csnap.get("planned", 0))
     fams.add("ramba_compile_class_padded_total", "counter",
@@ -422,6 +434,34 @@ def _compile_series(fams: _Families) -> None:
              psnap.get("bytes_read", 0))
     fams.add("ramba_compile_persist_bytes_written_total", "counter",
              psnap.get("bytes_written", 0))
+
+
+def _attrib_series(fams: _Families) -> None:
+    from ramba_tpu.observe import attrib as _attrib
+
+    rep = _attrib.attribution_report()
+    if not rep:
+        return  # no flush attributed yet: keep the exposition quiet
+    fams.add("ramba_flushes_attributed_total", "counter",
+             rep.get("flushes", 0))
+    for stage, s in rep.get("stage_seconds", {}).items():
+        fams.add("ramba_stage_seconds_total", "counter", s,
+                 {"stage": stage})
+    fams.add("ramba_stage_unattributed_seconds_total", "counter",
+             rep.get("unattributed_s", 0.0))
+    sentinel = rep.get("sentinel", {})
+    fams.add("ramba_perf_regressions_total", "counter",
+             sentinel.get("regressions", 0))
+    fams.add("ramba_perf_baselines", "gauge", sentinel.get("baselines", 0))
+    for fp, row in sorted(rep.get("rooflines", {}).items()):
+        labels = {"fingerprint": fp, "label": row.get("label", "?"),
+                  "bound": row.get("bound", "?")}
+        fams.add("ramba_roofline_frac_of_peak", "gauge",
+                 row.get("frac_of_peak", 0.0), labels)
+        fams.add("ramba_roofline_achieved_gb_per_s", "gauge",
+                 row.get("achieved_gb_per_s", 0.0), labels)
+        fams.add("ramba_roofline_achieved_tflops", "gauge",
+                 row.get("achieved_tflops", 0.0), labels)
 
 
 def _elastic_series(fams: _Families) -> None:
@@ -462,6 +502,10 @@ def render() -> str:
         _compile_series(fams)
     except Exception:
         pass  # compile classes / persist cache unused: skip
+    try:
+        _attrib_series(fams)
+    except Exception:
+        pass  # attribution plane unused: skip
     try:
         _elastic_series(fams)
     except Exception:

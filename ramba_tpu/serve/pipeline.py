@@ -36,6 +36,7 @@ import time
 from typing import Optional
 
 from ramba_tpu.core import fuser as _fuser
+from ramba_tpu.observe import attrib as _attrib
 from ramba_tpu.observe import events as _events
 from ramba_tpu.observe import ledger as _ledger
 from ramba_tpu.observe import registry as _registry
@@ -305,6 +306,7 @@ class CompilePipeline:
             ticket._resolve(result)
 
     def _dispatch_group(self, group: list) -> None:
+        t_group = time.perf_counter()
         n = len(group)
         if n > 1:
             self.batches += 1
@@ -366,6 +368,12 @@ class CompilePipeline:
                     "ticket abandoned by caller before dispatch"))
                 continue
             work.span["async"] = True
+            if n > 1:
+                # time this ticket spent behind its batch peers (group
+                # pop -> its own dispatch); queue_wait is stamped net of
+                # this slice at dispatch
+                _attrib.add_stage(work.span, "coalesce",
+                                  time.perf_counter() - t_group)
             plan = work.memo_plan
             key = (plan.key if plan is not None and plan.memoizable
                    and plan.key is not None else None)

@@ -65,6 +65,8 @@ _METRIC_DIRECTION = {
     "cold_start_ms": "lower",           # warm-process first-result wall
     "compile_hit_rate": "higher",       # bucketed shape-soak cache hits
     "bucket_pad_waste_frac": "lower",   # zero-padding overhead of pow2
+    "attrib_unattributed_frac": "lower",  # waterfall residual share
+    "roofline_peak_frac": "higher",     # best kernel's fraction of peak
 }
 
 
@@ -99,7 +101,12 @@ def load_capture(path: str) -> dict:
         k: obj[k] for k in _METRIC_DIRECTION
         if isinstance(obj.get(k), (int, float))
     }
-    return {"kernels": kernels or {}, "metrics": metrics}
+    kind = obj.get("device_kind")
+    if kind is None:
+        kind = obj.get("attribution", {}).get("device_kind") \
+            if isinstance(obj.get("attribution"), dict) else None
+    return {"kernels": kernels or {}, "metrics": metrics,
+            "device_kind": kind}
 
 
 def _exec_stat(entry: dict) -> tuple:
@@ -174,6 +181,13 @@ def main(argv=None) -> int:
         print(f"perf_diff: {args.old}: no kernels/metrics section "
               "(run with RAMBA_PERF=1?)", file=sys.stderr)
         return 2
+    ok, nk = old.get("device_kind"), new.get("device_kind")
+    if ok and nk and ok != nk:
+        # different silicon: ratios are apples-to-oranges — warn, don't
+        # gate (roofline fractions stay comparable, raw seconds don't)
+        print(f"perf_diff: WARNING: device_kind mismatch "
+              f"({ok!r} vs {nk!r}) — kernel-time ratios compare "
+              "different hardware", file=sys.stderr)
     regressions, improvements, skipped = diff(
         old, new, args.threshold, args.min_samples
     )

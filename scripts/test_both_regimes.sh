@@ -34,3 +34,5 @@ echo "=== leg 14: coherent load shedding (2-rank, rank-skewed serve:admit faults
 python scripts/two_process_suite.py --overload-leg
 echo "=== leg 15: compile classes + persistent warm start (2-rank lockstep buckets, AOT cache) ==="
 python scripts/two_process_suite.py --warmstart-leg
+echo "=== leg 16: critical-path attribution (2-rank lockstep stage waterfalls, rooflines) ==="
+python scripts/two_process_suite.py --attrib-leg

@@ -31,7 +31,15 @@ Prints, per input:
 aligned by their distributed bring-up anchor (clock skew subtracted),
 interleaved into one timeline, and the per-rank flush streams are
 compared in lockstep order to flag rank divergence (e.g. one rank
-degraded to ``chunked`` while another stayed ``fused``).
+degraded to ``chunked`` while another stayed ``fused``, or the two
+stamped different stage signatures for the same flush index).
+
+``--attrib`` switches to the stage-waterfall view of the attribution
+plane (observe/attrib.py): per-program stage decomposition of flush
+wall time (prepare / verify / queue_wait / coalesce / compile / admit /
+dispatch / device_execute / write_back), recent per-flush waterfalls,
+and the top programs by unattributed gap — the wall-clock the stage
+ledger could NOT explain, which is where to dig first.
 """
 
 from __future__ import annotations
@@ -41,6 +49,18 @@ import glob
 import json
 import sys
 from collections import defaultdict
+
+# canonical stage order (mirrors ramba_tpu.observe.attrib.STAGES —
+# duplicated so this script stays stdlib-only / copyable off-host)
+STAGE_ORDER = ("prepare", "verify", "queue_wait", "coalesce", "compile",
+               "admit", "dispatch", "device_execute", "write_back")
+
+
+def _stage_sig(flush: dict) -> str:
+    """Order-stable stage signature of one flush span ('' when the span
+    predates the stage ledger)."""
+    st = flush.get("stages") or {}
+    return ",".join(k for k in STAGE_ORDER if k in st)
 
 
 def _discover(path: str) -> list:
@@ -688,19 +708,123 @@ def merge_report(path: str, per_rank: dict, file=None, cap: int = 80) -> None:
     for i in range(depth):
         labels = {r: streams[r][i].get("label", "?") for r in ranks}
         rungs = {r: streams[r][i].get("degraded", "fused") for r in ranks}
-        if len(set(labels.values())) > 1 or len(set(rungs.values())) > 1:
-            diverged.append((i, labels, rungs))
+        sigs = {r: _stage_sig(streams[r][i]) for r in ranks}
+        if (len(set(labels.values())) > 1 or len(set(rungs.values())) > 1
+                or len(set(sigs.values())) > 1):
+            diverged.append((i, labels, rungs, sigs))
     if len(set(counts.values())) > 1:
         print("rank divergence: flush-count mismatch " + "  ".join(
             f"r{r}={counts[r]}" for r in ranks), file=file)
-    for i, labels, rungs in diverged[:20]:
-        print(f"rank divergence at flush #{i}: " + "  ".join(
-            f"r{r}={labels[r]}/{rungs[r]}" for r in ranks), file=file)
+    for i, labels, rungs, sigs in diverged[:20]:
+        line = f"rank divergence at flush #{i}: " + "  ".join(
+            f"r{r}={labels[r]}/{rungs[r]}" for r in ranks)
+        if len(set(sigs.values())) > 1:
+            line += "  stages " + "  ".join(
+                f"r{r}=[{sigs[r]}]" for r in ranks)
+        print(line, file=file)
     if len(diverged) > 20:
         print(f"  ... and {len(diverged) - 20} more", file=file)
     if not diverged and len(set(counts.values())) == 1:
         print(f"rank divergence: none ({depth} lockstep flushes, "
-              "labels and rungs agree)", file=file)
+              "labels, rungs and stage signatures agree)", file=file)
+    # per-rank stage-seconds columns: a rank burning its wall in a
+    # different stage than its peers is the cross-rank perf smell the
+    # lockstep labels above can't show
+    totals = {r: defaultdict(float) for r in ranks}
+    unatt = {r: 0.0 for r in ranks}
+    for r in ranks:
+        for e in streams[r]:
+            for k, v in (e.get("stages") or {}).items():
+                if isinstance(v, (int, float)):
+                    totals[r][k] += v
+            u = e.get("unattributed_s")
+            if isinstance(u, (int, float)):
+                unatt[r] += u
+    stages_seen = [k for k in STAGE_ORDER
+                   if any(totals[r].get(k) for r in ranks)]
+    if stages_seen:
+        print("stage seconds per rank:", file=file)
+        for k in stages_seen:
+            print(f"  {k:<15s} " + "  ".join(
+                f"r{r}={totals[r].get(k, 0.0):.4f}s" for r in ranks),
+                file=file)
+        print("  unattributed    " + "  ".join(
+            f"r{r}={unatt[r]:.4f}s" for r in ranks), file=file)
+
+
+def attrib_report(path: str, events: list, top: int = 10,
+                  file=None) -> int:
+    """Stage-waterfall view of one trace file (see observe/attrib.py).
+
+    Three blocks: per-program stage decomposition (where each program's
+    cumulative wall went), the most recent per-flush waterfalls, and the
+    top programs by unattributed gap — wall time none of the stage
+    stamps explain (fault injection, GC pauses, lock convoys, ...)."""
+    file = file or sys.stdout
+    flushes = [e for e in events
+               if e.get("type") == "flush" and e.get("stages")]
+    print(f"{path}:", file=file)
+    if not flushes:
+        print("  no stage-attributed flush spans "
+              "(trace predates the attribution plane?)", file=file)
+        return 1
+    per_label: dict = {}
+    for e in flushes:
+        agg = per_label.setdefault(e.get("label", "?"), {
+            "n": 0, "wall": 0.0, "unattributed": 0.0,
+            "stages": defaultdict(float),
+        })
+        agg["n"] += 1
+        agg["wall"] += e.get("wall_s") or 0.0
+        u = e.get("unattributed_s")
+        agg["unattributed"] += u if isinstance(u, (int, float)) else 0.0
+        for k, v in e["stages"].items():
+            if isinstance(v, (int, float)):
+                agg["stages"][k] += v
+
+    def _waterfall(stages: dict, wall: float, unattributed: float) -> str:
+        parts = []
+        for k in STAGE_ORDER:
+            v = stages.get(k)
+            if not v:
+                continue
+            pct = f" {v / wall:.0%}" if wall > 0 else ""
+            parts.append(f"{k}={v:.4f}s{pct}")
+        if unattributed:
+            pct = f" {unattributed / wall:.0%}" if wall > 0 else ""
+            parts.append(f"unattributed={unattributed:.4f}s{pct}")
+        return "  ".join(parts)
+
+    print(f"stage waterfall ({len(flushes)} attributed flush(es), "
+          f"{len(per_label)} program(s)):", file=file)
+    ranked = sorted(per_label.items(), key=lambda kv: kv[1]["wall"],
+                    reverse=True)
+    for label, agg in ranked[:top]:
+        print(f"  {label} x{agg['n']} wall={agg['wall']:.4f}s", file=file)
+        print("    " + _waterfall(agg["stages"], agg["wall"],
+                                  agg["unattributed"]), file=file)
+    recent = flushes[-8:]
+    print(f"recent flushes (last {len(recent)}):", file=file)
+    for e in recent:
+        wall = e.get("wall_s") or 0.0
+        u = e.get("unattributed_s")
+        u = u if isinstance(u, (int, float)) else 0.0
+        rung = e.get("degraded", "fused")
+        print(f"  {e.get('label', '?')} [{rung}] wall={wall:.4f}s  "
+              + _waterfall(e["stages"], wall, u), file=file)
+    gaps = sorted(per_label.items(), key=lambda kv: kv[1]["unattributed"],
+                  reverse=True)
+    gaps = [(lb, a) for lb, a in gaps if a["unattributed"] > 0][:top]
+    if gaps:
+        print(f"top {len(gaps)} program(s) by unattributed gap:",
+              file=file)
+        for label, agg in gaps:
+            share = (agg["unattributed"] / agg["wall"]
+                     if agg["wall"] > 0 else 0.0)
+            print(f"  {label:<22s} gap={agg['unattributed']:.4f}s "
+                  f"({share:.1%} of {agg['wall']:.4f}s, x{agg['n']})",
+                  file=file)
+    return 0
 
 
 def trace_chain(trace_id: str, per_rank: dict, file=None) -> int:
@@ -797,6 +921,10 @@ def main(argv=None) -> int:
                          " timeline and flag rank divergence")
     ap.add_argument("--merge-cap", type=int, default=80,
                     help="max merged timeline lines (default 80)")
+    ap.add_argument("--attrib", action="store_true",
+                    help="stage-waterfall view: per-program stage"
+                         " decomposition, recent per-flush waterfalls,"
+                         " top programs by unattributed gap")
     ap.add_argument("--trace", metavar="ID", default=None,
                     help="reconstruct one request's causal chain: every"
                          " event carrying this trace_id, across ranks,"
@@ -817,6 +945,19 @@ def main(argv=None) -> int:
                 r = _file_rank(f, evs)
                 per_rank.setdefault(r, []).extend(evs)
             rc = max(rc, trace_chain(args.trace, per_rank))
+        return rc
+
+    if args.attrib:
+        rc = 0
+        files = []
+        for p in args.paths:
+            found = _discover(p)
+            if not found:
+                print(f"{p}: no trace file found", file=sys.stderr)
+                return 2
+            files += [f for f in found if f not in files]
+        for f in files:
+            rc = max(rc, attrib_report(f, _load(f), top=args.top))
         return rc
 
     if args.merge_ranks:

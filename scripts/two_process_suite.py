@@ -48,6 +48,16 @@ compiled different programs — and then runs
 ``scripts/trace_report.py --merge-ranks`` over the per-rank traces to
 prove the cross-rank merged timeline works end to end.
 
+``--attrib-leg`` runs the critical-path-attribution acceptance leg
+(observe/attrib.py): the same 2-rank topology under ``RAMBA_PERF=1``
+with a pinned ``RAMBA_PEAKS_JSON``; each rank asserts its stage sums
+(plus the unattributed residual) reconcile with span wall time, then
+prints its lockstep per-flush stage signatures and per-fingerprint
+roofline boundedness classes.  The runner asserts both marker streams
+are IDENTICAL across ranks and that ``trace_report.py --attrib`` (stage
+waterfall) and ``--merge-ranks`` (per-rank stage columns, no
+divergence) both build from the traces.
+
 ``--elastic-leg`` runs the elastic-lifecycle acceptance leg: a 2-rank
 SPMD run (heartbeat on, watchdog armed) auto-checkpoints mid-workload
 via ``elastic.CheckpointManager.maybe_save`` into a shared directory and
@@ -234,6 +244,54 @@ assert keys, rep
 execs = sum(k['exec']['count'] for k in rep['kernels'].values())
 assert execs >= 1, rep
 print('PERF_LEG_KEYS rank=%d %s' % (rank, ','.join(keys)))
+"""
+
+
+# SPMD workload for the attribution leg: each rank runs the same flush
+# sequence, then prints (a) the per-flush stage signatures in lockstep
+# order and (b) the per-fingerprint roofline boundedness classes.  Both
+# must be identical across ranks: stage stamping is deterministic control
+# flow and classification is pure math over rank-agreed cost models and
+# a pinned peak table.  Each rank also checks that its stage sums plus
+# the unattributed residual reconcile with span wall time.
+# argv: <rank> <coordinator>.
+_ATTRIB_WORKLOAD = """
+import sys
+import numpy as np
+rank, coord = int(sys.argv[1]), sys.argv[2]
+from ramba_tpu.parallel import distributed
+distributed.initialize(coordinator_address=coord, num_processes=2,
+                       process_id=rank)
+import jax
+assert jax.process_count() == 2, jax.process_count()
+import ramba_tpu as rt
+from ramba_tpu import diagnostics
+from ramba_tpu.observe import attrib
+for _ in range(3):
+    a = rt.arange(8192) * 2.0 + 1.0
+    s = float(rt.sum(a))
+    b = rt.sqrt(rt.arange(4096) + 1.0)
+    s2 = float(rt.sum(b))
+exp = float(np.sum(np.arange(8192) * 2.0 + 1.0))
+assert abs(s - exp) <= 1e-5 * abs(exp), (s, exp)
+sigs = []
+for f in diagnostics.last_flushes(50):
+    st = f.get('stages')
+    if st is None:
+        continue
+    order = [k for k in attrib.STAGES if k in st]
+    wall = f.get('wall_s') or 0.0
+    tot = sum(st.values()) + f.get('unattributed_s', 0.0)
+    assert abs(tot - wall) <= max(0.05 * wall, 1e-3), (wall, tot, st)
+    sigs.append(f.get('label', '?') + ':' + ','.join(order))
+assert sigs, diagnostics.last_flushes(5)
+rep = diagnostics.perf_report()
+roofs = (rep.get('attribution') or {}).get('rooflines') or {}
+assert roofs, rep.get('attribution')
+roofmark = ','.join('%s=%s' % (fp, roofs[fp]['bound'])
+                    for fp in sorted(roofs))
+print('ATTRIB_LEG_STAGES rank=%d %s' % (rank, ';'.join(sigs)))
+print('ATTRIB_LEG_ROOFS rank=%d %s' % (rank, roofmark))
 """
 
 
@@ -1292,6 +1350,118 @@ def run_perf_leg() -> int:
     return 0 if ok else 1
 
 
+def run_attrib_leg() -> int:
+    """Two ranks under RAMBA_PERF=1 + a pinned peak table; both must
+    stamp lockstep stage signatures, classify every shared fingerprint
+    identically on the roofline, and reconcile stage sums with span
+    wall; the stage waterfall and merged stage columns must build."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    basetemp = tempfile.mkdtemp(prefix="ramba_2proc_attrib_")
+    trace_base = os.path.join(basetemp, "trace.jsonl")
+    budget = float(os.environ.get("RAMBA_TEST_PROCS_TIMEOUT", "600"))
+
+    procs, logs = [], []
+    for rank in range(2):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        for k in ("RAMBA_TEST_PROCS", "RAMBA_TEST_PROC_ID",
+                  "RAMBA_TEST_COORD", "RAMBA_TEST_SHARED_TMP",
+                  "RAMBA_PROFILE_DIR", "RAMBA_FAULTS", "RAMBA_HBM_BUDGET",
+                  "RAMBA_BASELINE_DIR"):
+            env.pop(k, None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["RAMBA_PERF"] = "1"
+        env["RAMBA_TRACE"] = trace_base
+        # same denominators on both ranks: classification must agree by
+        # construction, not by both hosts happening to probe alike
+        env["RAMBA_PEAKS_JSON"] = (
+            '{"default": {"peak_gbps": 100.0, "peak_tflops": 1.0}}')
+        log = open(os.path.join(basetemp, f"rank{rank}.log"), "w")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _ATTRIB_WORKLOAD, str(rank),
+             f"localhost:{port}"],
+            env=env, stdout=log, stderr=subprocess.STDOUT, cwd=REPO,
+        ))
+
+    deadline = time.time() + budget
+    rcs = [None, None]
+    try:
+        for i, p in enumerate(procs):
+            left = max(5.0, deadline - time.time())
+            try:
+                rcs[i] = p.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                rcs[i] = -9
+    finally:
+        for log in logs:
+            log.close()
+
+    ok = all(rc == 0 for rc in rcs)
+
+    marks = {"ATTRIB_LEG_STAGES": [None, None],
+             "ATTRIB_LEG_ROOFS": [None, None]}
+    for rank in range(2):
+        path = os.path.join(basetemp, f"rank{rank}.log")
+        with open(path) as f:
+            tail = f.read().splitlines()
+        for line in tail:
+            for key in marks:
+                if line.startswith(f"{key} rank={rank} "):
+                    marks[key][rank] = line.split(" ", 2)[2]
+        if any(marks[key][rank] is None for key in marks):
+            ok = False
+        print(f"--- attrib leg rank {rank} rc={rcs[rank]} ({path}) ---")
+        print("\n".join(tail[-(4 if ok else 40):]))
+    for key, vals in marks.items():
+        if ok and vals[0] != vals[1]:
+            print(f"attrib leg: FAIL ({key} diverges: "
+                  f"r0={vals[0]} r1={vals[1]})")
+            ok = False
+    if ok:
+        nflush = len((marks["ATTRIB_LEG_STAGES"][0] or "").split(";"))
+        nroof = len((marks["ATTRIB_LEG_ROOFS"][0] or "").split(","))
+        print(f"attrib leg: {nflush} lockstep stage signature(s), "
+              f"{nroof} roofline class(es), identical on both ranks")
+
+    # The stage waterfall and the merged stage columns must build from
+    # the per-rank traces with no rank divergence.
+    if ok:
+        waterfall = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "trace_report.py"),
+             trace_base, "--attrib"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        print(waterfall.stdout.strip())
+        if (waterfall.returncode != 0
+                or "stage waterfall" not in waterfall.stdout):
+            print(f"attrib leg: FAIL (--attrib rc={waterfall.returncode})")
+            print(waterfall.stderr.strip())
+            ok = False
+    if ok:
+        merged = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "trace_report.py"),
+             trace_base, "--merge-ranks"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        print(merged.stdout.strip())
+        if (merged.returncode != 0
+                or "rank divergence: none" not in merged.stdout
+                or "stage seconds per rank:" not in merged.stdout):
+            print(f"attrib leg: FAIL (merge-ranks rc={merged.returncode})")
+            print(merged.stderr.strip())
+            ok = False
+
+    print(f"two-process attrib leg: {'OK' if ok else 'FAIL'}")
+    if ok:
+        shutil.rmtree(basetemp, ignore_errors=True)
+    return 0 if ok else 1
+
+
 def run_memo_leg() -> int:
     """Two ranks under RAMBA_MEMO=1; both must compute the identical
     canonical hash and hit the result cache in LOCKSTEP (a hit skips
@@ -2146,6 +2316,8 @@ def main() -> int:
         return run_memory_leg()
     if "--perf-leg" in sys.argv[1:]:
         return run_perf_leg()
+    if "--attrib-leg" in sys.argv[1:]:
+        return run_attrib_leg()
     if "--serving-leg" in sys.argv[1:]:
         return run_serving_leg()
     if "--elastic-leg" in sys.argv[1:]:
