@@ -612,6 +612,21 @@ def _bench_observe(rt, platform):
     out["observe_scrape_ms"] = round(
         (time.perf_counter() - t0) / scrapes * 1e3, 3)
 
+    # fleet snapshot publish: one full spool-document write (snapshot +
+    # identity + signals + atomic tmp/replace).  This runs on a daemon
+    # thread every RAMBA_FLEET_INTERVAL_S in production, so the number
+    # bounds the background tax per publish, not a hot-path cost.
+    from ramba_tpu.observe import fleet as _fleet
+
+    with tempfile.TemporaryDirectory() as td:
+        _fleet.publish(td)  # warm lazy imports
+        pubs = 5
+        t0 = time.perf_counter()
+        for _ in range(pubs):
+            _fleet.publish(td)
+        out["fleet_snapshot_ms"] = round(
+            (time.perf_counter() - t0) / pubs * 1e3, 3)
+
     # coherence round cost: the full agreement-round bookkeeping (epoch,
     # event, transfer ledger) over the loopback transport — the per-round
     # floor every coherent recovery decision pays on top of the wire.

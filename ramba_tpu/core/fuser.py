@@ -59,6 +59,7 @@ from ramba_tpu.core import memo as _memo
 from ramba_tpu.core.expr import Const, Expr, Node, Scalar, OPS
 from ramba_tpu.observe import attrib as _attrib
 from ramba_tpu.observe import events as _events
+from ramba_tpu.observe import fleet as _fleet
 from ramba_tpu.observe import ledger as _ledger
 from ramba_tpu.observe import profile as _profile
 from ramba_tpu.observe import registry as _registry
@@ -1651,6 +1652,7 @@ def _flush_prepare(stream: FlushStream, roots: list,
             _events.emit(pev)
         _profile.ensure_started()
         _telemetry.ensure_started()
+        _fleet.ensure_started()
         # In-flight leaves are never spill candidates: admission-triggered
         # (or oom-triggered) eviction during THIS flush must not pull a
         # buffer the program is about to read.

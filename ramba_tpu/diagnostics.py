@@ -16,10 +16,47 @@ in one place.  For offline trace files (RAMBA_TRACE), use
 from __future__ import annotations
 
 import json
+import os
+import socket
 import sys
 from typing import Optional
 
 from ramba_tpu.observe import events as _events, registry as _registry
+
+#: Version of the :func:`snapshot` JSON contract.  Bump on any change
+#: that breaks a consumer of the dump (key renamed/removed, semantics
+#: changed) — additive keys do NOT bump it.  The fleet collector
+#: (observe/fleet.py) refuses to aggregate snapshots whose major version
+#: differs from its own, so a mixed-version fleet degrades to "replica
+#: skipped, reason=schema" instead of silently mis-merging counters.
+SCHEMA_VERSION = 1
+
+
+def identity() -> dict:
+    """The process-identity block: who produced this snapshot.
+
+    ``(host, pid, rank)`` names the replica; ``start_time_wall`` (plus
+    its monotonic twin) distinguishes incarnations of a recycled pid;
+    ``schema_version`` versions the contract the rest of the snapshot
+    follows.  Stamped onto every snapshot, flight-recorder dump, and
+    fleet spool file so federated tooling can join/dedup replicas."""
+    try:
+        from ramba_tpu.observe import attrib as _attrib
+
+        kind = _attrib.device_kind()
+    except Exception:
+        kind = None
+    rank, nprocs = _events.rank_info()
+    return {
+        "schema_version": SCHEMA_VERSION,
+        "host": socket.gethostname(),
+        "pid": os.getpid(),
+        "rank": rank,
+        "nprocs": nprocs,
+        "device_kind": kind,
+        "start_time_wall": _registry.START_WALL,
+        "start_time_mono": _registry.START_MONO,
+    }
 
 
 def counters() -> dict:
@@ -190,6 +227,8 @@ def snapshot() -> dict:
     import time as _time
 
     snap = _registry.snapshot()
+    snap["schema_version"] = SCHEMA_VERSION
+    snap["identity"] = identity()
     snap["captured_at"] = round(_time.time(), 6)
     snap["captured_mono"] = round(_time.monotonic(), 6)
     snap["events"] = _events.snapshot_ring()
@@ -436,3 +475,35 @@ def reset() -> None:
     _events.ring.clear()
     _ledger.reset()
     _slo.reset()
+
+
+def main(argv=None) -> int:
+    """``python -m ramba_tpu.diagnostics`` — the machine-readable dump
+    entrypoint.  ``--json`` writes one :func:`snapshot` object (the
+    versioned contract external tooling and the fleet collector consume)
+    to stdout or ``-o <path>``; without it, the human summary of
+    :func:`report` goes to stdout."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m ramba_tpu.diagnostics",
+        description="Dump the process diagnostics snapshot "
+                    f"(schema_version {SCHEMA_VERSION}).")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the snapshot as one JSON object")
+    ap.add_argument("-o", "--output", default=None, metavar="PATH",
+                    help="write the JSON snapshot to PATH (implies --json)")
+    args = ap.parse_args(argv)
+    if args.output:
+        dump(args.output)
+        print(args.output)
+    elif args.json:
+        json.dump(snapshot(), sys.stdout, default=str)
+        sys.stdout.write("\n")
+    else:
+        report(file=sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,9 +31,16 @@ Environment variables:
 * ``RAMBA_BASELINE_DIR`` / ``RAMBA_PERF_DRIFT_FACTOR`` /
   ``RAMBA_PERF_DRIFT_MIN_SAMPLES`` — perf-regression sentinel: persisted
   per-kernel device-time baselines and the drift trip point.
+* ``RAMBA_FLEET_DIR`` — fleet snapshot spool: publish an atomic versioned
+  ``diagnostics.snapshot()`` document to ``<dir>/<host>-<pid>-<rank>.json``
+  every ``RAMBA_FLEET_INTERVAL_S`` seconds (default 5); the collector in
+  ``fleet``/``scripts/fleet_collector.py`` classifies each replica
+  healthy/degraded/stale/dead (``RAMBA_FLEET_STALE_X`` /
+  ``RAMBA_FLEET_DEAD_X`` x interval age thresholds, defaults 1.5 / 2.0).
 
 Public read API lives in ``ramba_tpu.diagnostics`` (``perf_report()`` for
-the ledger, including the ``attribution`` section).
+the ledger, including the ``attribution`` section); the fleet-level read
+API is ``ramba_tpu.observe.fleet`` (``health()`` / ``rollup()``).
 """
 
-from ramba_tpu.observe import attrib, events, health, ledger, profile, registry  # noqa: F401
+from ramba_tpu.observe import attrib, events, fleet, health, ledger, profile, registry  # noqa: F401

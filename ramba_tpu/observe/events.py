@@ -133,6 +133,13 @@ def _rank_info():
     return _rank
 
 
+def rank_info() -> tuple:
+    """Public ``(rank, nprocs)`` — the identity block of the fleet spool
+    and the exporter's ``.rank<i>`` textfile suffixing both key on this.
+    Same caching discipline as the emit path (see :func:`_rank_info`)."""
+    return _rank_info()
+
+
 def invalidate_rank() -> None:
     """Drop the cached (rank, nprocs) AND any trace sink opened under the
     stale identity — ``distributed.initialize`` calls this the moment the

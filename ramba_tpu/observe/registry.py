@@ -20,7 +20,17 @@ XLA's profiler owns exact per-execution collective traffic.
 from __future__ import annotations
 
 import threading
+import time
 from collections import defaultdict
+
+# Process birth stamps, frozen at first import of the observability plane
+# (one pair per process lifetime).  The fleet spool and the
+# ``ramba_process_info`` exporter series use these to distinguish "same
+# pid, new incarnation" — a restarted replica publishes a NEW start_wall,
+# so a federated collector never merges two lives of one pid into one
+# counter history.
+START_WALL: float = round(time.time(), 6)
+START_MONO: float = round(time.monotonic(), 6)
 
 # One lock for the whole store: the stores are touched together (snapshot,
 # reset) and individual updates are tiny, so finer grain buys nothing.

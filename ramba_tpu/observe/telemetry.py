@@ -32,11 +32,15 @@ on hosts where opening a port is not an option.  Both can run at once.
 on the event stream watches for incident events — ``slow_flush``,
 ``stall`` (RankStallError), ``slo_breach``, ``flush_error``
 (quarantine), and oom-class memory eviction — and dumps the bounded
-event ring plus a full ``diagnostics.snapshot()`` to one JSON file per
-triggering event, named by the event's ``seq`` so the dump is exactly
-once per incident and sorts in incident order.  The ring itself is
-always on (observe/events.py), so the recorder's steady-state cost is
-one set-membership test per event.
+event ring plus a full ``diagnostics.snapshot()`` (stamped with the
+process-identity block) to one JSON file per triggering event, named by
+the event's ``seq`` so the dump is exactly once per incident and sorts
+in incident order.  ``RAMBA_FLIGHT_MAX`` (default 50) is per-process
+disk retention: every incident still dumps, but the process's oldest
+files are evicted past the cap, so a week-long fleet soak cannot grow
+``RAMBA_FLIGHT_DIR`` without bound.  The ring itself is always on
+(observe/events.py), so the recorder's steady-state cost is one
+set-membership test per event.
 """
 
 from __future__ import annotations
@@ -156,17 +160,45 @@ def _flight_tap(event: dict) -> None:
         _flight_tls.busy = False
 
 
+def _own_flight_dumps(directory: str) -> list:
+    """THIS process's dump files in ``directory``, oldest first (names
+    sort in incident-seq order).  Multi-rank processes write ``.rank<i>``
+    suffixed names, so each rank GCs only its own files — a fleet of
+    replicas pointed at per-replica flight dirs (the recommended layout)
+    or SPMD ranks sharing one dir never evict each other's incidents."""
+    import glob as _glob
+
+    rank, nprocs = _events._rank_info()
+    if nprocs > 1:
+        pattern = os.path.join(directory, f"flight_*.rank{rank}.json")
+        return sorted(_glob.glob(pattern))
+    return sorted(p for p in _glob.glob(
+        os.path.join(directory, "flight_*.json")) if ".rank" not in p)
+
+
+def _gc_flight(directory: str) -> None:
+    """Oldest-first disk retention: keep at most ``RAMBA_FLIGHT_MAX``
+    of this process's dumps.  A long fleet soak keeps dumping fresh
+    incidents forever; the cap bounds DISK, not incident count."""
+    keep = _flight_max()
+    own = _own_flight_dumps(directory)
+    for path in own[:max(0, len(own) - keep)]:
+        try:
+            os.remove(path)
+            _registry.inc("telemetry.flight_gc")
+        except OSError:
+            pass  # concurrent GC / manual cleanup
+
+
 def dump_flight(incident: dict, directory: Optional[str] = None) -> Optional[str]:
-    """Write one flight record (incident + ring + diagnostics snapshot)
-    and return its path, or None when disabled/over cap."""
+    """Write one flight record (incident + identity + ring + diagnostics
+    snapshot), evict this process's oldest dumps past ``RAMBA_FLIGHT_MAX``,
+    and return the new path (None when disabled)."""
     d = directory or _flight_dir()
     if d is None:
         return None
     global _flight_dumps
     with _flight_lock:
-        if _flight_dumps >= _flight_max():
-            _registry.inc("telemetry.flight_dropped")
-            return None
         _flight_dumps += 1
         n = _flight_dumps
     from ramba_tpu import diagnostics as _diagnostics
@@ -182,6 +214,7 @@ def dump_flight(incident: dict, directory: Optional[str] = None) -> Optional[str
         "incident": incident,
         "dump_n": n,
         "rank": rank,
+        "identity": _diagnostics.identity(),
         "events": _events.snapshot_ring(),
         "diagnostics": _diagnostics.snapshot(),
     }
@@ -190,6 +223,8 @@ def dump_flight(incident: dict, directory: Optional[str] = None) -> Optional[str
         json.dump(record, f, default=str)
     os.replace(tmp, path)  # readers never see a torn dump
     _registry.inc("telemetry.flight_dumps")
+    with _flight_lock:
+        _gc_flight(d)
     return path
 
 
@@ -272,7 +307,8 @@ class _Families:
                 lab.update(labels)
                 body = ",".join(f'{k}="{_esc(v)}"'
                                 for k, v in sorted(lab.items()))
-                lines.append(f"{f.name}{suffix}{{{body}}} {_fmt(value)}")
+                labels_part = f"{{{body}}}" if body else ""
+                lines.append(f"{f.name}{suffix}{labels_part} {_fmt(value)}")
         return "\n".join(lines) + "\n"
 
 
@@ -480,12 +516,33 @@ def _elastic_series(fams: _Families) -> None:
     fams.add("ramba_stalls_total", "counter", rep.get("stalls", 0))
 
 
+def _process_info_series(fams: _Families) -> None:
+    """``ramba_process_info`` — the identity series federated scrapes
+    join/dedup replicas on: constant value 1, all information in the
+    labels (the node-exporter ``*_info`` convention).  ``start_time``
+    distinguishes incarnations of a recycled pid."""
+    from ramba_tpu import diagnostics as _diagnostics
+
+    ident = _diagnostics.identity()
+    fams.add("ramba_process_info", "gauge", 1, {
+        "pid": ident["pid"],
+        "host": ident["host"],
+        "device_kind": ident["device_kind"] or "",
+        "start_time": ident["start_time_wall"],
+        "schema_version": ident["schema_version"],
+    })
+
+
 def render() -> str:
     """The full Prometheus exposition.  Each source is snapshotted under
     its own lock (internally consistent per subsystem); a scrape is one
     moment per subsystem, not one global stop-the-world."""
     rank, _nprocs = _events._rank_info()
     fams = _Families({"rank": rank})
+    try:
+        _process_info_series(fams)
+    except Exception:
+        pass  # identity must never break a scrape
     snap = _registry.snapshot()
     _counter_series(fams, snap, _registry.gauge_names())
     _ledger_series(fams)
@@ -515,9 +572,21 @@ def render() -> str:
     return fams.render()
 
 
+def textfile_path(path: str) -> str:
+    """The actual path one process rewrites: ``<path>.rank<i>`` under
+    multi-controller SPMD (same suffixing as events.py's trace JSONL).
+    Two ranks handed the same ``RAMBA_TELEMETRY``/``RAMBA_METRICS_FILE``
+    path would otherwise take turns clobbering each other's atomic
+    rewrites — each scrape would see whichever rank replaced last."""
+    rank, nprocs = _events._rank_info()
+    return path if nprocs <= 1 else f"{path}.rank{rank}"
+
+
 def write_textfile(path: str) -> None:
     """One atomic textfile rewrite (tmp + replace): a scraper reading the
-    file never sees a partial exposition."""
+    file never sees a partial exposition.  Multi-rank processes write
+    per-rank siblings (see :func:`textfile_path`)."""
+    path = textfile_path(path)
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     tmp = f"{path}.{os.getpid()}.tmp"

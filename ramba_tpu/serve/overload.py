@@ -826,6 +826,27 @@ def run_hedged(execute: Callable[[dict], tuple], threshold_s: float, *,
 # ---------------------------------------------------------------------------
 
 
+def health_signals() -> dict:
+    """Compact liveness-relevant slice of the overload plane — what the
+    fleet snapshot spool publishes every interval and the collector's
+    replica health model (observe/fleet.py) classifies on.  Deliberately
+    tiny and always present (unlike the quiet-when-idle ``overload``
+    section of ``diagnostics.snapshot()``): a router polling fleet
+    health must see ``brownout == "green"`` as a positive signal, not
+    infer it from an absent key."""
+    with _brownout.lock:
+        state = _brownout.state
+    with _breaker_lock:
+        snaps = {t: b.snapshot() for t, b in _breakers.items()}
+    return {
+        "brownout": state,
+        "open_breakers": sorted(t for t, s in snaps.items()
+                                if s["state"] == "open"),
+        "breaker_trips": sum(s["trips"] for s in snaps.values()),
+        "shed_total": _registry.get("serve.shed"),
+    }
+
+
 def report() -> dict:
     """Machine-readable overload rollup for diagnostics: brownout state
     + transitions, per-tenant breaker states, shed/hedge counters."""

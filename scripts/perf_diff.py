@@ -57,6 +57,7 @@ _METRIC_DIRECTION = {
     "observe_events_per_s": "higher",
     "observe_flush_overhead_pct": "lower",
     "observe_scrape_ms": "lower",
+    "fleet_snapshot_ms": "lower",       # one spool-document publish
     "coherence_overhead_ms": "lower",   # loopback agreement-round floor
     "reshard_gb_per_s": "higher",       # staged layout-change collectives
     "reshard_peak_live_bytes": "lower",  # ledger peak during the reshard

@@ -36,3 +36,5 @@ echo "=== leg 15: compile classes + persistent warm start (2-rank lockstep bucke
 python scripts/two_process_suite.py --warmstart-leg
 echo "=== leg 16: critical-path attribution (2-rank lockstep stage waterfalls, rooflines) ==="
 python scripts/two_process_suite.py --attrib-leg
+echo "=== leg 17: fleet observability federation (3 publishers + collector, kill-mid-soak) ==="
+python scripts/two_process_suite.py --fleet-leg

@@ -64,14 +64,62 @@ def _stage_sig(flush: dict) -> str:
 
 
 def _discover(path: str) -> list:
-    """The file itself, or its .rank* siblings (multi-controller runs)."""
-    files = []
+    """The file itself, or its .rank* siblings (multi-controller runs).
+    A DIRECTORY discovers every trace JSONL beneath it — the fleet
+    layout, where each replica process wrote its own trace dir/file."""
     import os
 
+    if os.path.isdir(path):
+        return _walk_fleet_dir(path)
+    files = []
     if os.path.exists(path):
         files.append(path)
     files += sorted(glob.glob(glob.escape(path) + ".rank*"))
     return files
+
+
+def _walk_fleet_dir(root: str) -> list:
+    """Every ``*.jsonl`` / ``*.jsonl.rank<i>`` file under ``root``,
+    sorted — one entry per per-process trace stream."""
+    import os
+
+    out = []
+    for dirpath, _dirs, names in os.walk(root):
+        for name in sorted(names):
+            if ".jsonl" in name and not name.endswith(".tmp"):
+                out.append(os.path.join(dirpath, name))
+    return sorted(out)
+
+
+def _rname(r) -> str:
+    """Display name of one stream key: SPMD ranks are ints (``r0``),
+    fleet replicas are path-derived string labels used verbatim."""
+    return f"r{r}" if isinstance(r, int) else str(r)
+
+
+def _load_streams(path: str):
+    """``{stream_key: [events]}`` for one input.  A plain file keys its
+    ``.rank<i>`` siblings by integer rank; a directory keys each
+    discovered file by its relative path (the replica label), so two
+    replicas that each called themselves rank 0 stay distinct streams.
+    Returns None when nothing was found."""
+    import os
+
+    if os.path.isdir(path):
+        streams: dict = {}
+        for f in _walk_fleet_dir(path):
+            label = os.path.relpath(f, path).replace(os.sep, "/")
+            label = label.replace(".jsonl", "") or label
+            streams.setdefault(label, []).extend(_load(f))
+        return streams or None
+    found = _discover(path)
+    if not found:
+        return None
+    streams = {}
+    for f in found:
+        evs = _load(f)
+        streams.setdefault(_file_rank(f, evs), []).extend(evs)
+    return streams
 
 
 def _load(path: str) -> list:
@@ -614,7 +662,9 @@ def _merge_line(e: dict) -> str:
 def merge_report(path: str, per_rank: dict, file=None, cap: int = 80) -> None:
     """Cross-rank merged timeline + rank-divergence analysis.
 
-    ``per_rank`` maps rank -> event list.  Per-rank clock skew is
+    ``per_rank`` maps rank -> event list; keys are integer SPMD ranks
+    for file inputs and replica path labels for directory (fleet)
+    inputs — the analysis is identical.  Per-rank clock skew is
     estimated from the bring-up anchor (see ``_anchor_ts``) and
     subtracted, then all ranks' noteworthy events are interleaved by
     adjusted timestamp (seq breaks ties within a rank).  Divergence
@@ -639,13 +689,13 @@ def merge_report(path: str, per_rank: dict, file=None, cap: int = 80) -> None:
             # honest — any other offset would be invented — but the
             # timeline reader must know this rank floats.
             skew[r] = 0.0
-            print(f"rank r{r}: no bring-up anchor event — UNANCHORED "
+            print(f"rank {_rname(r)}: no bring-up anchor event — UNANCHORED "
                   "(skew 0 assumed, cross-rank ordering approximate)",
                   file=file)
         else:
             skew[r] = anchors[r][0] - base
     print("rank skew (vs earliest anchor): " + "  ".join(
-        f"r{r}={skew[r]:+.4f}s" for r in ranks), file=file)
+        f"{_rname(r)}={skew[r]:+.4f}s" for r in ranks), file=file)
 
     def _adjusted(r: int, e: dict):
         """Event time on the common (earliest-anchor) axis.  When both
@@ -690,7 +740,7 @@ def merge_report(path: str, per_rank: dict, file=None, cap: int = 80) -> None:
     print(f"noteworthy events ({len(shown)} of {len(merged)} stamped):",
           file=file)
     for adj, _seq, r, e in shown[:cap]:
-        print(f"  +{adj - t0:8.3f}s r{r}  {_merge_line(e)}", file=file)
+        print(f"  +{adj - t0:8.3f}s {_rname(r)}  {_merge_line(e)}", file=file)
     if len(shown) > cap:
         print(f"  ... and {len(shown) - cap} more", file=file)
 
@@ -714,13 +764,13 @@ def merge_report(path: str, per_rank: dict, file=None, cap: int = 80) -> None:
             diverged.append((i, labels, rungs, sigs))
     if len(set(counts.values())) > 1:
         print("rank divergence: flush-count mismatch " + "  ".join(
-            f"r{r}={counts[r]}" for r in ranks), file=file)
+            f"{_rname(r)}={counts[r]}" for r in ranks), file=file)
     for i, labels, rungs, sigs in diverged[:20]:
         line = f"rank divergence at flush #{i}: " + "  ".join(
-            f"r{r}={labels[r]}/{rungs[r]}" for r in ranks)
+            f"{_rname(r)}={labels[r]}/{rungs[r]}" for r in ranks)
         if len(set(sigs.values())) > 1:
             line += "  stages " + "  ".join(
-                f"r{r}=[{sigs[r]}]" for r in ranks)
+                f"{_rname(r)}=[{sigs[r]}]" for r in ranks)
         print(line, file=file)
     if len(diverged) > 20:
         print(f"  ... and {len(diverged) - 20} more", file=file)
@@ -746,10 +796,10 @@ def merge_report(path: str, per_rank: dict, file=None, cap: int = 80) -> None:
         print("stage seconds per rank:", file=file)
         for k in stages_seen:
             print(f"  {k:<15s} " + "  ".join(
-                f"r{r}={totals[r].get(k, 0.0):.4f}s" for r in ranks),
+                f"{_rname(r)}={totals[r].get(k, 0.0):.4f}s" for r in ranks),
                 file=file)
         print("  unattributed    " + "  ".join(
-            f"r{r}={unatt[r]:.4f}s" for r in ranks), file=file)
+            f"{_rname(r)}={unatt[r]:.4f}s" for r in ranks), file=file)
 
 
 def attrib_report(path: str, events: list, top: int = 10,
@@ -828,16 +878,21 @@ def attrib_report(path: str, events: list, top: int = 10,
 
 
 def trace_chain(trace_id: str, per_rank: dict, file=None) -> int:
-    """Reconstruct ONE request's causal chain across ranks.
+    """Reconstruct ONE request's causal chain across processes.
 
     Every event stamped with ``trace_id`` (directly, or via the
     ``trace_ids`` list on a coalesced-batch event) is collected from all
-    rank files and re-threaded by span parentage: the ``serve_session``
+    input streams (SPMD ranks, or fleet replicas when the input was a
+    directory) and re-threaded by span parentage: the ``serve_session``
     root, then each flush span in time order, with that span's child
     events (degrade rungs, stalls, memory admissions, slow_flush
     verdicts, barrier spans) indented beneath it — the end-to-end story
-    of one request, even when its pieces executed on different ranks and
-    interleaved with thousands of unrelated events."""
+    of one request, even when its pieces executed on different processes
+    and interleaved with thousands of unrelated events.  A child whose
+    ``parent_span`` resolves to NO span in the inputs is an orphaned
+    half: its other side ran in a process whose trace was not collected
+    (or was lost) — flagged explicitly instead of silently filed as
+    session-level."""
     file = file or sys.stdout
     evs = []
     for r in sorted(per_rank):
@@ -870,19 +925,21 @@ def trace_chain(trace_id: str, per_rank: dict, file=None) -> int:
     roots = [(r, e) for r, e in evs if e.get("type") == "serve_session"]
     spans = [(r, e) for r, e in evs if e.get("type") == "flush"]
     span_ids = {e.get("span_id") for _, e in spans if e.get("span_id")}
+    root_ids = {e.get("span_id") for _, e in roots if e.get("span_id")}
     children = defaultdict(list)
     for r, e in evs:
         if e.get("type") in ("serve_session", "flush"):
             continue
         children[e.get("parent_span")].append((r, e))
 
+    names = [_rname(r) for r in ranks]
     print(f"== trace {trace_id}: {len(evs)} events across "
-          f"{len(ranks)} rank(s) {ranks} ==", file=file)
+          f"{len(ranks)} process(es) {names} ==", file=file)
     for r, e in roots:
         line = f"session   stream={e.get('stream', '?')}"
         if e.get("tenant"):
             line += f" tenant={e['tenant']}"
-        print(f"{rel(e)} r{r}  {line}", file=file)
+        print(f"{rel(e)} {_rname(r)}  {line}", file=file)
     for i, (r, e) in enumerate(spans):
         line = (f"flush #{i}  {e.get('label', '?')}"
                 f" rung={e.get('degraded', 'fused')}"
@@ -892,19 +949,36 @@ def trace_chain(trace_id: str, per_rank: dict, file=None) -> int:
         line += f" wall={e.get('wall_s', 0):.4f}s"
         if e.get("coalesced"):
             line += f" coalesced={e['coalesced']}"
-        print(f"{rel(e)} r{r}  {line}", file=file)
+        print(f"{rel(e)} {_rname(r)}  {line}", file=file)
         for cr, c in sorted(children.get(e.get("span_id"), []),
                             key=lambda p: p[1].get("seq", 0)):
-            print(f"{rel(c)} r{cr}    └ {_merge_line(c)}", file=file)
+            print(f"{rel(c)} {_rname(cr)}    └ {_merge_line(c)}", file=file)
     # events parented by the session root (or nothing resolvable): the
-    # slo_breach verdict, coalesce joins, pre-span stalls
-    orphans = [(pid, kids) for pid, kids in children.items()
-               if pid not in span_ids]
-    rest = [p for _pid, kids in orphans for p in kids]
-    if rest:
+    # slo_breach verdict, coalesce joins, pre-span stalls.  Split by
+    # whether the parent actually resolves: parent_span == a session
+    # root (or unset) is normal session-level fan-in; a parent id that
+    # matches NOTHING in the inputs means the other half of this trace
+    # lives in a process we did not collect — an orphaned half.
+    session_level = []
+    orphaned = []
+    for pid, kids in children.items():
+        if pid in span_ids:
+            continue
+        if pid is None or pid in root_ids:
+            session_level.extend(kids)
+        else:
+            orphaned.extend((pid, cr, c) for cr, c in kids)
+    if session_level:
         print("session-level events:", file=file)
-        for cr, c in sorted(rest, key=_key):
-            print(f"{rel(c)} r{cr}  {_merge_line(c)}", file=file)
+        for cr, c in sorted(session_level, key=_key):
+            print(f"{rel(c)} {_rname(cr)}  {_merge_line(c)}", file=file)
+    if orphaned:
+        print(f"ORPHANED events ({len(orphaned)}) — parent span not in "
+              "any collected stream (other half of the trace missing):",
+              file=file)
+        for pid, cr, c in sorted(orphaned, key=lambda t: _key(t[1:])):
+            print(f"{rel(c)} {_rname(cr)}  {_merge_line(c)}"
+                  f"  [parent_span={pid}]", file=file)
     return 0
 
 
@@ -935,15 +1009,10 @@ def main(argv=None) -> int:
     if args.trace:
         rc = 0
         for p in args.paths:
-            found = _discover(p)
-            if not found:
+            per_rank = _load_streams(p)
+            if per_rank is None:
                 print(f"{p}: no trace file found", file=sys.stderr)
                 return 2
-            per_rank: dict = {}
-            for f in found:
-                evs = _load(f)
-                r = _file_rank(f, evs)
-                per_rank.setdefault(r, []).extend(evs)
             rc = max(rc, trace_chain(args.trace, per_rank))
         return rc
 
@@ -962,15 +1031,10 @@ def main(argv=None) -> int:
 
     if args.merge_ranks:
         for p in args.paths:
-            found = _discover(p)
-            if not found:
+            per_rank = _load_streams(p)
+            if per_rank is None:
                 print(f"{p}: no trace file found", file=sys.stderr)
                 return 2
-            per_rank: dict = {}
-            for f in found:
-                evs = _load(f)
-                r = _file_rank(f, evs)
-                per_rank.setdefault(r, []).extend(evs)
             merge_report(p, per_rank, cap=args.merge_cap)
         return 0
 

@@ -118,6 +118,18 @@ asserts each rank's scrape is labeled with its own distinct
 RAMBA_TRACE event files — the inputs ``trace_report.py --trace`` needs
 to reconstruct one request across the fleet.
 
+``--fleet-leg`` runs the fleet-observability-federation acceptance leg
+(PR 16): three INDEPENDENT replica processes (not SPMD ranks) run the
+identical traced serving flush with ``RAMBA_FLEET_DIR`` pointed at one
+shared snapshot spool.  The runner drives ``scripts/fleet_collector.py``
+through the whole replica lifecycle: all replicas healthy with lockstep
+kernel fingerprints, the fleet goodput rollup reconciling against the
+raw per-replica spool documents within 1%, an injected torn document
+classified stale without a collector crash, a replica SIGKILLed
+mid-soak flagged dead within 2x the publish interval, and the
+cross-process ``trace_report.py --trace`` chain stitched over the
+per-replica trace directories.
+
 ``--memo-leg`` runs the result-memoization acceptance leg: both ranks
 under ``RAMBA_MEMO=1`` canonicalize the same program (including its
 commutative-operand swap — ``analyze.canonicalize`` must produce the
@@ -597,6 +609,48 @@ assert 'ramba_serve_tenant_flushes_total' in body, body[:400]
 assert 'ramba_flush_e2e_seconds_bucket' in body, body[:400]
 print('TELEMETRY_LEG_SCRAPE rank=%d labels=%s port=%d' % (
     rank, ','.join(labels), port))
+"""
+
+
+# Workload for the fleet leg: N INDEPENDENT replica processes (not SPMD
+# ranks — each is its own single-process serving job, the fleet topology
+# the snapshot spool federates).  Each replica runs the IDENTICAL traced
+# serving flush (lockstep kernel fingerprints across the fleet), lets
+# the spool publisher autostart off the flush path, forces one
+# synchronous publish so the READY marker implies a document on disk,
+# then soaks (publishing every RAMBA_FLEET_INTERVAL_S) until killed or
+# the soak budget ends.  argv: <idx> <trace_id> <soak_s>.
+_FLEET_WORKLOAD = """
+import sys
+import time
+import numpy as np
+idx, trace, soak_s = int(sys.argv[1]), sys.argv[2], float(sys.argv[3])
+import ramba_tpu as rt
+from ramba_tpu import serve
+from ramba_tpu.observe import fleet, ledger
+from ramba_tpu.serve.pipeline import CompilePipeline
+pipe = CompilePipeline(coalesce=8)
+pipe._ensure_worker = lambda: None  # deterministic: dispatch inline
+with serve.Session(tenant='fleet', pipeline=pipe, trace_id=trace) as s:
+    assert s.trace_id == trace
+    a = rt.arange(4096) * 3.0 + 1.0  # IDENTICAL program on every replica
+    t = s.flush()
+    g = pipe.queue.pop_group(
+        8, fingerprint_of=lambda t: t.work.fingerprint, timeout=0)
+    assert len(g) == 1, len(g)
+    pipe._dispatch_group(g)
+    assert t.wait(timeout=120) == []
+    assert np.allclose(np.asarray(a), np.arange(4096) * 3.0 + 1.0)
+pipe.stop()
+assert fleet.started(), 'spool publisher must autostart off the flush path'
+path = fleet.publish()
+assert path, path
+print('FLEET_REPLICA_OK idx=%d fps=%s' % (
+    idx, ','.join(ledger.kernel_keys())), flush=True)
+deadline = time.monotonic() + soak_s
+while time.monotonic() < deadline:
+    time.sleep(0.05)
+print('FLEET_SOAK_DONE idx=%d' % idx, flush=True)
 """
 
 
@@ -1249,12 +1303,232 @@ def run_telemetry_leg() -> int:
             capture_output=True, text=True, cwd=REPO,
         )
         print(merged.stdout.strip())
-        if merged.returncode != 0 or "2 rank(s)" not in merged.stdout:
+        if merged.returncode != 0 or "2 process(es)" not in merged.stdout:
             print(f"telemetry leg: FAIL (--trace rc={merged.returncode})")
             print(merged.stderr.strip())
             ok = False
 
     print(f"two-process telemetry leg: {'OK' if ok else 'FAIL'}")
+    if ok:
+        shutil.rmtree(basetemp, ignore_errors=True)
+    return 0 if ok else 1
+
+
+def run_fleet_leg() -> int:
+    """Fleet observability federation acceptance (PR 16): three
+    INDEPENDENT replica processes publish into one snapshot spool.  The
+    collector must (a) prove every live replica healthy with lockstep
+    kernel fingerprints, (b) reconcile the fleet goodput rollup against
+    the per-replica spool documents within 1%, (c) classify an injected
+    torn document without crashing, (d) flag a replica killed mid-soak
+    dead within 2x the publish interval, and (e) the stitched --trace
+    view over the per-replica trace dirs must span the replicas."""
+    import json
+    import signal
+
+    basetemp = tempfile.mkdtemp(prefix="ramba_2proc_fleet_")
+    fleet_dir = os.path.join(basetemp, "fleet")
+    traces = os.path.join(basetemp, "traces")
+    interval = 0.2
+    soak_s = 120.0
+    shared_trace = "feedfacef1ee70001"
+    budget = float(os.environ.get("RAMBA_TEST_PROCS_TIMEOUT", "600"))
+    n = 3
+    collector = os.path.join(REPO, "scripts", "fleet_collector.py")
+
+    procs, logs = [], []
+    for idx in range(n):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        for k in ("RAMBA_TEST_PROCS", "RAMBA_TEST_PROC_ID",
+                  "RAMBA_TEST_COORD", "RAMBA_TEST_SHARED_TMP",
+                  "RAMBA_PROFILE_DIR", "RAMBA_FAULTS", "RAMBA_HBM_BUDGET",
+                  "RAMBA_METRICS_PORT", "RAMBA_METRICS_FILE",
+                  "RAMBA_FLIGHT_DIR", "RAMBA_FLEET_DIR",
+                  "RAMBA_FLEET_INTERVAL_S", "RAMBA_FLEET_STALE_X",
+                  "RAMBA_FLEET_DEAD_X"):
+            env.pop(k, None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["RAMBA_FLEET_DIR"] = fleet_dir
+        env["RAMBA_FLEET_INTERVAL_S"] = str(interval)
+        tdir = os.path.join(traces, f"replica{idx}")
+        os.makedirs(tdir, exist_ok=True)
+        env["RAMBA_TRACE"] = os.path.join(tdir, "trace.jsonl")
+        log = open(os.path.join(basetemp, f"replica{idx}.log"), "w")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _FLEET_WORKLOAD, str(idx),
+             shared_trace, str(soak_s)],
+            env=env, stdout=log, stderr=subprocess.STDOUT, cwd=REPO,
+        ))
+
+    ok = True
+    deadline = time.time() + budget
+
+    def _tail(idx):
+        with open(os.path.join(basetemp, f"replica{idx}.log")) as f:
+            return f.read().splitlines()
+
+    def _collect(expect_rc, phase):
+        nonlocal ok
+        r = subprocess.run(
+            [sys.executable, collector, fleet_dir, "--json"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        doc = None
+        try:
+            doc = json.loads(r.stdout)
+        except ValueError:
+            pass
+        if "Traceback" in r.stderr or doc is None:
+            print(f"fleet leg: FAIL ({phase}: collector crashed)")
+            print(r.stdout[-2000:] + r.stderr[-2000:])
+            ok = False
+        elif r.returncode != expect_rc:
+            print(f"fleet leg: FAIL ({phase}: collector rc={r.returncode}, "
+                  f"want {expect_rc})")
+            print(json.dumps(doc.get("health", {}), indent=2)[:2000])
+            ok = False
+        return doc
+
+    # -- phase A: every replica publishes and goes healthy -------------------
+    fps = [None] * n
+    while time.time() < deadline and any(f is None for f in fps):
+        for idx in range(n):
+            if fps[idx] is not None:
+                continue
+            for line in _tail(idx):
+                if line.startswith(f"FLEET_REPLICA_OK idx={idx}"):
+                    fps[idx] = line.split("fps=")[1].strip()
+            if fps[idx] is None and procs[idx].poll() is not None:
+                print(f"fleet leg: FAIL (replica {idx} exited "
+                      f"rc={procs[idx].returncode} before READY)")
+                print("\n".join(_tail(idx)[-40:]))
+                ok = False
+                deadline = 0  # bail out of the wait loop
+        if ok and any(f is None for f in fps):
+            time.sleep(0.1)
+    if ok and any(f is None for f in fps):
+        print(f"fleet leg: FAIL (timeout waiting for READY markers {fps})")
+        ok = False
+
+    if ok:
+        if not fps[0] or len(set(fps)) != 1:
+            print(f"fleet leg: FAIL (kernel fingerprints not lockstep: "
+                  f"{fps})")
+            ok = False
+        else:
+            print(f"fleet leg: {n} replicas ready, lockstep kernel "
+                  f"fingerprints [{fps[0]}]")
+
+    if ok:
+        doc = _collect(0, "healthy fleet")
+        if ok:
+            h = doc["health"]
+            if (h["fleet_state"] != "healthy"
+                    or h["counts"]["healthy"] != n):
+                print(f"fleet leg: FAIL (want {n} healthy, got "
+                      f"{h['counts']} fleet_state={h['fleet_state']})")
+                ok = False
+            else:
+                ages = [r["age_s"] for r in h["replicas"].values()]
+                print(f"fleet leg: collector proves {n} healthy "
+                      f"(max snapshot age {max(ages):.2f}s)")
+
+        # rollup reconciliation: fleet goodput vs the raw spool documents
+        if ok:
+            raw_flushes = raw_nodes = 0
+            for f in sorted(os.listdir(fleet_dir)):
+                with open(os.path.join(fleet_dir, f)) as fh:
+                    d = json.load(fh)
+                counters = d["diagnostics"]["counters"]
+                raw_flushes += int(counters.get("fuser.flushes", 0))
+                raw_nodes += int(counters.get("fuser.nodes_flushed", 0))
+            gp = doc["rollup"]["goodput"]
+            per_rep_sum = sum(r["flushes"]
+                              for r in gp["replicas"].values())
+            drift = abs(gp["flushes"] - raw_flushes) \
+                / max(1, raw_flushes)
+            if (gp["flushes"] != per_rep_sum or drift > 0.01
+                    or raw_flushes == 0):
+                print(f"fleet leg: FAIL (rollup {gp['flushes']} != "
+                      f"per-replica {per_rep_sum} / raw {raw_flushes})")
+                ok = False
+            else:
+                print(f"fleet leg: rollup reconciles (fleet "
+                      f"flushes={gp['flushes']} == raw spool sum "
+                      f"{raw_flushes}, nodes={raw_nodes})")
+
+    # -- phase B: stitched cross-process trace -------------------------------
+    if ok:
+        merged = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts",
+                                          "trace_report.py"),
+             traces, "--trace", shared_trace],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        print(merged.stdout.strip())
+        if (merged.returncode != 0
+                or f"{n} process(es)" not in merged.stdout):
+            print(f"fleet leg: FAIL (--trace over {traces} "
+                  f"rc={merged.returncode})")
+            print(merged.stderr.strip())
+            ok = False
+
+    # -- phase C: torn document never crashes the collector ------------------
+    if ok:
+        torn = os.path.join(fleet_dir, "torn-deadbeef-0.json")
+        with open(torn, "w") as f:
+            f.write('{"schema_version": 1, "replica": "torn-deadbe')
+        doc = _collect(2, "torn document")  # stale present -> rc 2
+        if ok:
+            row = doc["health"]["replicas"].get("torn-deadbeef-0")
+            if row is None or row["state"] != "stale":
+                print(f"fleet leg: FAIL (torn doc classified {row})")
+                ok = False
+            else:
+                print(f"fleet leg: torn document classified stale "
+                      f"({row['reason']}), no crash")
+        os.unlink(torn)
+
+    # -- phase D: replica killed mid-soak goes dead within 2x interval -------
+    if ok:
+        victim = n - 1
+        procs[victim].send_signal(signal.SIGKILL)
+        procs[victim].wait(timeout=30)
+        t_kill = time.monotonic()
+        # the last publish predates the kill, so the snapshot's age
+        # crosses the dead threshold no later than kill + 2x interval
+        time.sleep(2.0 * interval)
+        doc = _collect(3, "dead replica")  # dead present -> rc 3
+        elapsed = time.monotonic() - t_kill
+        if ok:
+            dead = [rep for rep, r in doc["health"]["replicas"].items()
+                    if r["state"] == "dead"]
+            counts = doc["health"]["counts"]
+            if len(dead) != 1 or counts["healthy"] != n - 1:
+                print(f"fleet leg: FAIL (want 1 dead / {n - 1} healthy "
+                      f"{elapsed:.2f}s after kill, got {counts})")
+                ok = False
+            else:
+                age = doc["health"]["replicas"][dead[0]]["age_s"]
+                print(f"fleet leg: killed replica {dead[0]} flagged dead "
+                      f"at the first scrape past 2x interval "
+                      f"({elapsed:.2f}s after SIGKILL, snapshot age "
+                      f"{age:.2f}s, dead threshold {2 * interval:.1f}s)")
+
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+    for log in logs:
+        log.close()
+    print(f"fleet leg: {'OK' if ok else 'FAIL'}")
     if ok:
         shutil.rmtree(basetemp, ignore_errors=True)
     return 0 if ok else 1
@@ -2326,6 +2600,8 @@ def main() -> int:
         return run_reshard_leg()
     if "--telemetry-leg" in sys.argv[1:]:
         return run_telemetry_leg()
+    if "--fleet-leg" in sys.argv[1:]:
+        return run_fleet_leg()
     if "--autotune-leg" in sys.argv[1:]:
         return run_autotune_leg()
     if "--memo-leg" in sys.argv[1:]:

@@ -17,8 +17,11 @@ integration seams:
   consistent scrape while flushes run),
 * atomic textfile export (no partial file visible),
 * flight recorder: exactly-once dump per incident under a seeded
-  RAMBA_FAULTS stall, dump contents (incident + ring + diagnostics with
-  one capture stamp), RAMBA_FLIGHT_MAX cap,
+  RAMBA_FAULTS stall, dump contents (incident + identity + ring +
+  diagnostics with one capture stamp), RAMBA_FLIGHT_MAX oldest-first
+  retention GC,
+* the ``ramba_process_info`` identity series and multi-rank textfile
+  ``.rank<i>`` suffixing,
 * monotonic ``mono`` stamps on events, ``snapshot_ring`` consistency,
   and trace_report.py: ``--trace`` chain reconstruction and merge-ranks
   tolerance of an anchorless rank file.
@@ -269,6 +272,36 @@ def test_textfile_export_atomic(tmp_path):
     assert want in path.read_text()
 
 
+def test_process_info_identity_series():
+    """The ``*_info`` convention: value 1, identity in the labels — the
+    series federated fleet scrapes join/dedup replicas on."""
+    body = telemetry.render()
+    lines = [ln for ln in body.splitlines()
+             if ln.startswith("ramba_process_info{")]
+    assert len(lines) == 1, body[:400]
+    line = lines[0]
+    assert f'pid="{os.getpid()}"' in line
+    assert f'schema_version="{diagnostics.SCHEMA_VERSION}"' in line
+    assert 'host="' in line and 'start_time="' in line
+    assert line.endswith(" 1")
+
+
+@spmd_skip
+def test_textfile_path_multirank_suffix(tmp_path, monkeypatch):
+    """Two ranks handed the same textfile path must not clobber each
+    other's atomic rewrites: nprocs>1 auto-suffixes ``.rank<i>``."""
+    p = str(tmp_path / "m.prom")
+    assert telemetry.textfile_path(p) == p  # single process: unchanged
+    monkeypatch.setattr(events, "_rank", (1, 2))
+    try:
+        assert telemetry.textfile_path(p) == f"{p}.rank1"
+        telemetry.write_textfile(p)
+        assert os.path.exists(f"{p}.rank1") and not os.path.exists(p)
+        assert 'rank="1"' in open(f"{p}.rank1").read()
+    finally:
+        events.invalidate_rank()
+
+
 # -- trace propagation -------------------------------------------------------
 
 
@@ -404,6 +437,8 @@ def test_flight_recorder_exactly_once_per_incident(tmp_path, monkeypatch):
         assert rec["incident"]["type"] == "slow_flush"
         assert rec["events"], "ring included"
         assert "captured_at" in rec["diagnostics"]
+        assert rec["identity"]["pid"] == os.getpid()
+        assert rec["identity"]["schema_version"] == diagnostics.SCHEMA_VERSION
         assert os.path.basename(dumps[0]).startswith(
             f"flight_{rec['incident']['seq']:06d}_")
         assert registry.get("telemetry.flight_dumps") == 1
@@ -412,15 +447,25 @@ def test_flight_recorder_exactly_once_per_incident(tmp_path, monkeypatch):
 
 
 @spmd_skip
-def test_flight_recorder_cap(tmp_path, monkeypatch):
+def test_flight_recorder_cap_is_retention_gc(tmp_path, monkeypatch):
+    """RAMBA_FLIGHT_MAX is disk retention, not an incident budget: every
+    incident dumps, then the OLDEST of this process's files are evicted
+    past the cap — a week-long soak keeps the freshest incidents instead
+    of going blind after the first N."""
     monkeypatch.setenv("RAMBA_FLIGHT_DIR", str(tmp_path))
     monkeypatch.setenv("RAMBA_FLIGHT_MAX", "2")
     telemetry.flight_reset()
+    gc0 = registry.get("telemetry.flight_gc")
+    dumps0 = registry.get("telemetry.flight_dumps")
     for i in range(5):
         events.emit({"type": "slo_breach", "tenant": "x", "n": i})
-    dumps = glob.glob(str(tmp_path / "flight_*.json"))
+    dumps = sorted(glob.glob(str(tmp_path / "flight_*.json")))
     assert len(dumps) == 2
-    assert registry.get("telemetry.flight_dropped") >= 3
+    assert registry.get("telemetry.flight_dumps") - dumps0 == 5
+    assert registry.get("telemetry.flight_gc") - gc0 == 3
+    # the two survivors are the NEWEST incidents (oldest-first eviction)
+    ns = sorted(json.loads(open(p).read())["incident"]["n"] for p in dumps)
+    assert ns == [3, 4]
 
 
 def test_flight_recorder_off_without_dir(tmp_path):
