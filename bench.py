@@ -652,6 +652,106 @@ def _bench_observe(rt, platform):
     return out
 
 
+def _bench_fleet(rt, platform):
+    """Fleet serving-plane section (PR 17): real replica subprocesses
+    behind the router, sharing one artifact tier.
+
+    * ``router_overhead_ms`` — median end-to-end wall of one tiny pure
+      step through router + authenticated transport + replica dispatch:
+      the per-step tax of serving through the fleet plane instead of
+      in-process.
+    * ``cross_replica_aot_hit_rate`` — fraction of a COLD second
+      replica's executable demands served by the first replica's
+      persisted AOT blobs (shared memo lane off so the compiler is
+      actually exercised).
+    * ``failover_heal_ms`` — wall of the first step after the serving
+      replica is SIGKILLed: redirect off the corpse + deterministic
+      replay heal on the survivor + the step itself.
+    """
+    import tempfile
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts"))
+    import fleet_router
+
+    from ramba_tpu.fleet.router import Router
+
+    out = {}
+    base = tempfile.mkdtemp(prefix="ramba-bench-fleet-")
+    shared = {
+        "RAMBA_FLEET_DIR": os.path.join(base, "spool"),
+        "RAMBA_ARTIFACTS": os.path.join(base, "artifacts"),
+        "RAMBA_CACHE": os.path.join(base, "aot"),
+        "RAMBA_MEMO": "1",
+        "RAMBA_FLEET_INTERVAL_S": "1",
+    }
+    steps = [("init", {"name": "x", "shape": [256], "fill": 2.0})] + [
+        ("affine", {"name": "x", "a": 1.01, "b": float(i)})
+        for i in range(4)]
+    procs = []
+    try:
+        # phase 1: warm replica — per-step overhead, then persist AOT
+        p_a, ep_a = fleet_router.spawn_replica(dict(shared))
+        procs.append(p_a)
+        r_a = Router(endpoints=[ep_a])
+        sid = r_a.open_session(tenant="bench")
+        for w, p in steps:
+            r_a.step(sid, w, p)
+        walls = []
+        for _ in range(30):
+            t0 = time.perf_counter()
+            r_a.step(sid, "sum", {"name": "x"})
+            walls.append(time.perf_counter() - t0)
+        out["router_overhead_ms"] = round(
+            sorted(walls)[len(walls) // 2] * 1e3, 3)
+        r_a.call_replica(ep_a, "save_artifacts", k=16)
+        r_a.close_session(sid)
+        r_a.shutdown_fleet()
+        p_a.wait(timeout=30)
+
+        # phase 2: cold replica, shared memo lane off — every flush
+        # demand-compiles against the shared AOT tier
+        p_b, ep_b = fleet_router.spawn_replica(
+            {**shared, "RAMBA_MEMO_SHARED": "0"})
+        procs.append(p_b)
+        r_b = Router(endpoints=[ep_b])
+        sid = r_b.open_session(tenant="bench")
+        for w, p in steps:
+            r_b.step(sid, w, p)
+        c = r_b.call_replica(ep_b, "stats")["counters"]
+        cross = c["compile.persist_cross_hit"]
+        out["cross_replica_aot_hit_rate"] = round(
+            cross / max(1, cross + c["fuser.compiles"]), 3)
+        r_b.close_session(sid)
+
+        # phase 3: kill the serving replica mid-session; the next step
+        # pays redirect + replay heal on the survivor
+        p_c, ep_c = fleet_router.spawn_replica(dict(shared))
+        procs.append(p_c)
+        r_f = Router(endpoints=[ep_b, ep_c])
+        by_ep = {ep_b: p_b, ep_c: p_c}
+        sid = r_f.open_session(tenant="bench-failover")
+        for w, p in steps[:2]:
+            r_f.step(sid, w, p)
+        victim = r_f.stats()["sessions"][sid]["endpoint"]
+        by_ep[victim].kill()
+        by_ep[victim].wait(timeout=30)
+        t0 = time.perf_counter()
+        r_f.step(sid, *steps[2])
+        out["failover_heal_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 2)
+        r_f.close_session(sid)
+        r_f.shutdown_fleet()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        import shutil
+
+        shutil.rmtree(base, ignore_errors=True)
+    return out
+
+
 def _bench_autotune(rt, platform):
     """Backend-autotune section (only when ``RAMBA_AUTOTUNE`` is armed):
     drive the fused sin/cos chain until the ledger race latches, report
@@ -1174,6 +1274,11 @@ def main():
             out.update(_bench_observe(rt, platform))
         except Exception:  # noqa: BLE001
             out["observe_error"] = traceback.format_exc(limit=2)[-300:]
+
+        try:
+            out.update(_bench_fleet(rt, platform))
+        except Exception:  # noqa: BLE001
+            out["fleet_error"] = traceback.format_exc(limit=2)[-300:]
 
         try:
             out.update(_bench_autotune(rt, platform))

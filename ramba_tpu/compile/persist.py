@@ -33,6 +33,7 @@ from __future__ import annotations
 import hashlib
 import os
 import pickle
+import socket
 import tempfile
 import threading
 from typing import Optional, Sequence
@@ -51,6 +52,10 @@ _state = {"dir": None, "armed": False, "init_error": None}
 stats = {
     "hits": 0,
     "misses": 0,
+    # hits whose entry was written by a DIFFERENT process (the writer
+    # identity rides the payload) — the cross-replica warm-start signal
+    # the fleet suite leg asserts on (fleet/artifacts.py)
+    "cross_hits": 0,
     "corrupt": 0,
     "stores": 0,
     "store_errors": 0,
@@ -59,6 +64,10 @@ stats = {
     "bytes_written": 0,
     "programs_saved": 0,
 }
+
+
+def _writer_identity() -> dict:
+    return {"host": socket.gethostname(), "pid": os.getpid()}
 
 # fingerprint -> candidate record for save_topk (bounded; no array refs)
 _candidates: dict = {}
@@ -265,10 +274,16 @@ def lookup(fp: str, leaf_vals: Sequence, program, donate_key):
         except OSError:
             pass
         return None
+    writer = payload.get("writer")
+    cross = bool(writer) and writer != _writer_identity()
     with _lock:
         stats["hits"] += 1
+        if cross:
+            stats["cross_hits"] += 1
         stats["bytes_read"] += len(raw)
     _registry.inc("compile.persist_hit")
+    if cross:
+        _registry.inc("compile.persist_cross_hit")
     return AotDispatcher(loaded, sig, program, donate_key)
 
 
@@ -446,7 +461,8 @@ def store_entry(fp: str, sig: tuple, program_rec=None,
 
         blob, in_tree, out_tree = _se.serialize(compiled)
         data = pickle.dumps(
-            {"fp": fp, "sig": sig, "payload": (blob, in_tree, out_tree)})
+            {"fp": fp, "sig": sig, "payload": (blob, in_tree, out_tree),
+             "writer": _writer_identity()})
         _atomic_write(path, data)
     except Exception:  # noqa: BLE001 — AOT store is best-effort
         with _lock:

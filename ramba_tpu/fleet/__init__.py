@@ -1,0 +1,50 @@
+"""Fleet serving plane: tenant router, replica failover, shared artifacts.
+
+PR 16 (``observe/fleet.py``) gave a replica fleet *eyes* — every process
+publishes an atomic snapshot of its diagnostics state into a shared
+spool and a collector classifies each replica healthy / degraded /
+stale / dead.  This package gives the fleet *hands*: it routes, heals,
+and shares.
+
+* :mod:`ramba_tpu.fleet.artifacts` — the **shared artifact tier**.  The
+  result-memo cache (PR 12) and the persistent AOT executable cache
+  (PR 14) are both content-addressed (canonical chash / semantic
+  fingerprints), so their entries are valid on ANY replica of the same
+  code + numerics regime.  Backing them with one shared directory
+  (``RAMBA_ARTIFACTS``) means one replica's compile or memoized result
+  warms the whole fleet — the federated warm start the PR-16 rollup's
+  cache comparison was built to detect the absence of.
+* :mod:`ramba_tpu.fleet.replica` — a **replica server**: one ramba_tpu
+  process serving tenant sessions over a length-prefixed pickle
+  transport (``multiprocessing.connection`` — stdlib, authenticated),
+  publishing its health into the PR-16 spool, refusing work exactly the
+  way the in-process overload plane does (breakers, brownout, queues).
+* :mod:`ramba_tpu.fleet.router` — the **tenant router**: spreads tenant
+  sessions across N replicas with rendezvous-hash affinity, consumes
+  the PR-16 spool as its health feed, keeps a fleet-level circuit
+  breaker per replica, turns replica refusals into redirects (the
+  ``redirect`` retry-classification rung: retryable *elsewhere*, not
+  retryable *here*), hedges pure steps onto a second replica (PR-13
+  hedging promoted from kernel level to replica level), and heals the
+  sessions of a SIGKILL'd replica onto survivors by deterministic
+  step-log replay — byte-identical because every step is deterministic
+  and the shared artifact tier makes the replay warm.
+* :mod:`ramba_tpu.fleet.migrate` — **drained-session handoff** built on
+  the PR-7 checkpoint path: ``export_session`` drains a live session to
+  an atomic checkpoint + manifest, ``adopt_session`` restores it on
+  another replica, so the router can rebalance live tenants off a
+  degraded replica without recomputation.
+
+``scripts/fleet_router.py`` wraps replica serving and router driving in
+a CLI; ``scripts/two_process_suite.py --router-leg`` is the acceptance
+story (cross-replica warm start, kill-one-replica-mid-soak heal).
+"""
+
+from ramba_tpu.fleet import artifacts, migrate, replica, router  # noqa: F401
+from ramba_tpu.fleet.router import (  # noqa: F401
+    FleetError,
+    NoHealthyReplica,
+    ReplicaRefusal,
+    ReplicaUnavailable,
+    Router,
+)

@@ -1,0 +1,325 @@
+"""Shared artifact tier: one directory that warms the whole fleet.
+
+Two existing caches are already content-addressed and therefore valid on
+any replica running the same code and numerics regime:
+
+* the **result memo** (``core/memo.py``, PR 12) — keyed by canonical
+  subgraph hash × input versions × semantic fingerprint.  Its in-process
+  key binds inputs by *buffer identity*, which cannot cross a process
+  boundary; this module adds the content-addressed form (sha256 over
+  each input's dtype/shape/bytes in canonical leaf order) so a result
+  computed on replica A is a memo hit on replica B.
+* the **AOT executable cache** (``compile/persist.py``, PR 14) — already
+  a directory of ``<fingerprint>-<avalsig>.aot`` blobs.  Pointing every
+  replica's ``RAMBA_CACHE`` at a shared path IS the shared tier; this
+  module supplies the race discipline both tiers follow and the memo
+  blob store.
+
+Write discipline (the same contract as ``telemetry.write_textfile`` /
+``checkpoint.save``): every writer stages into its own **exclusive**
+temp name (``tempfile.mkstemp`` — O_EXCL, pid-unique) and publishes with
+``os.replace``.  Two replicas racing the same key therefore land exactly
+one complete winner (last ``replace`` wins; the entries are
+content-addressed so the loser's bytes were identical anyway), a reader
+mid-rename never observes a torn blob, and a temp file on disk means a
+dead writer — :func:`gc_stale_tmp` sweeps them by age.  Corruption on
+read is evicted and recomputed, never raised: a shared cache must only
+ever make a replica faster, not break it.
+
+Environment:
+
+* ``RAMBA_ARTIFACTS`` — the shared directory; unset disarms the tier.
+* ``RAMBA_MEMO_SHARED`` — ``0`` keeps the AOT tier but disables the
+  shared memo lane (default on when the tier is armed).
+* ``RAMBA_MEMO_SHARED_MAX`` — per-entry logical byte cap for shared
+  memo blobs (``common.parse_bytes``, default ``8m``): content-hashing
+  inputs and serializing outputs is host work, so only small, hot
+  results ride the shared lane.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import tempfile
+import threading
+import time
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from ramba_tpu import common as _common
+from ramba_tpu.observe import registry as _registry
+
+_OFF = ("0", "off", "false", "no")
+
+_lock = threading.Lock()
+_state = {"dir": None}
+
+#: running counters; snapshot() adds config
+stats = {
+    "memo_stores": 0,
+    "memo_store_errors": 0,
+    "memo_hits": 0,
+    "memo_misses": 0,
+    "memo_corrupt": 0,
+    "memo_skipped_large": 0,
+    "tmp_gcd": 0,
+}
+
+
+def configure(directory: Optional[str] = None) -> None:
+    """(Re)arm the tier on ``RAMBA_ARTIFACTS`` or an explicit override
+    (tests).  A bad directory disarms instead of raising."""
+    with _lock:
+        d = directory if directory is not None \
+            else (os.environ.get("RAMBA_ARTIFACTS") or None)
+        if not d:
+            _state["dir"] = None
+            return
+        try:
+            os.makedirs(os.path.join(d, "memo"), exist_ok=True)
+            os.makedirs(os.path.join(d, "handoff"), exist_ok=True)
+            _state["dir"] = d
+        except OSError:
+            _state["dir"] = None
+            _registry.inc("artifacts.init_error")
+
+
+def armed() -> bool:
+    if _state["dir"] is None:
+        configure()
+    return _state["dir"] is not None
+
+
+def artifacts_dir() -> Optional[str]:
+    return _state["dir"]
+
+
+def handoff_dir() -> Optional[str]:
+    """Session-migration staging area (``fleet/migrate.py``):
+    ``RAMBA_HANDOFF_DIR`` override, else ``<artifacts>/handoff``."""
+    d = os.environ.get("RAMBA_HANDOFF_DIR")
+    if d:
+        try:
+            os.makedirs(d, exist_ok=True)
+            return d
+        except OSError:
+            return None
+    if armed():
+        return os.path.join(_state["dir"], "handoff")
+    return None
+
+
+def memo_shared_enabled() -> bool:
+    raw = (os.environ.get("RAMBA_MEMO_SHARED") or "").strip().lower()
+    return armed() and raw not in _OFF
+
+
+def memo_shared_max_bytes() -> int:
+    raw = os.environ.get("RAMBA_MEMO_SHARED_MAX")
+    if raw:
+        try:
+            return max(0, _common.parse_bytes(raw))
+        except ValueError:
+            pass
+    return 8 << 20
+
+
+# ---------------------------------------------------------------------------
+# atomic blob store (the race discipline)
+# ---------------------------------------------------------------------------
+
+
+def store_blob(path: str, data: bytes) -> bool:
+    """Publish ``data`` at ``path`` atomically.  Single-writer by
+    construction: the temp name is exclusive (mkstemp) so two racing
+    writers never share a staging file, and ``os.replace`` makes the
+    publish a single rename — a concurrent reader sees the old complete
+    blob or the new complete blob, never a torn one."""
+    try:
+        parent = os.path.dirname(path)
+        os.makedirs(parent, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=parent, prefix=".tmp-")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return True
+    except OSError:
+        return False
+
+
+def load_blob(path: str) -> Optional[bytes]:
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+def evict(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+def gc_stale_tmp(directory: Optional[str] = None,
+                 max_age_s: float = 300.0) -> int:
+    """Sweep dead writers' staging debris: any ``.tmp-*`` older than
+    ``max_age_s`` in the tier (or an explicit directory).  A live writer
+    holds its temp file for milliseconds, so age is the tombstone."""
+    roots: List[str] = []
+    if directory is not None:
+        roots.append(directory)
+    elif armed():
+        roots.append(os.path.join(_state["dir"], "memo"))
+    removed = 0
+    now = time.time()
+    for root in roots:
+        try:
+            names = os.listdir(root)
+        except OSError:
+            continue
+        for name in names:
+            if not name.startswith(".tmp-"):
+                continue
+            p = os.path.join(root, name)
+            try:
+                if now - os.stat(p).st_mtime > max_age_s:
+                    os.unlink(p)
+                    removed += 1
+            except OSError:
+                pass
+    if removed:
+        with _lock:
+            stats["tmp_gcd"] += removed
+        _registry.inc("artifacts.tmp_gcd", removed)
+    return removed
+
+
+# ---------------------------------------------------------------------------
+# shared memo lane (content-addressed results)
+# ---------------------------------------------------------------------------
+
+
+def content_key(chash: str, parts: Sequence[Any], fingerprint) -> \
+        Optional[str]:
+    """Content-addressed shared-memo key: canonical hash × sha256 over
+    every input's (dtype, shape, bytes) in canonical leaf order × the
+    semantic fingerprint.  ``parts`` entries are either hashable scalar
+    tokens or array-likes; returns None when the combined input bytes
+    exceed the shared-lane cap or a value cannot be content-hashed."""
+    h = hashlib.sha256()
+    h.update(chash.encode())
+    budget = memo_shared_max_bytes()
+    seen = 0
+    for p in parts:
+        if isinstance(p, tuple):  # scalar token from the memo plan
+            h.update(repr(p).encode())
+            continue
+        try:
+            a = np.asarray(p)
+        except Exception:  # noqa: BLE001 — unhashable input: no shared key
+            return None
+        seen += a.nbytes
+        if budget and seen > budget:
+            with _lock:
+                stats["memo_skipped_large"] += 1
+            return None
+        h.update(str(a.dtype).encode())
+        h.update(repr(a.shape).encode())
+        h.update(a.tobytes())
+    h.update(repr(fingerprint).encode())
+    return h.hexdigest()[:32]
+
+
+def _memo_path(key: str) -> str:
+    return os.path.join(_state["dir"], "memo", f"{key}.npz")
+
+
+def memo_store(key: str, outs: Sequence[Any]) -> bool:
+    """Publish one flush's outputs under a content key.  Best-effort:
+    non-ndarray-convertible outputs or an over-cap payload skip."""
+    if not memo_shared_enabled():
+        return False
+    try:
+        arrays = [np.asarray(v) for v in outs]
+    except Exception:  # noqa: BLE001 — non-addressable buffers: skip
+        return False
+    budget = memo_shared_max_bytes()
+    if budget and sum(a.nbytes for a in arrays) > budget:
+        with _lock:
+            stats["memo_skipped_large"] += 1
+        return False
+    buf = io.BytesIO()
+    try:
+        np.savez(buf, **{f"out{i}": a for i, a in enumerate(arrays)})
+    except Exception:  # noqa: BLE001 — exotic dtypes: skip
+        with _lock:
+            stats["memo_store_errors"] += 1
+        return False
+    if not store_blob(_memo_path(key), buf.getvalue()):
+        with _lock:
+            stats["memo_store_errors"] += 1
+        _registry.inc("artifacts.memo_store_error")
+        return False
+    with _lock:
+        stats["memo_stores"] += 1
+    _registry.inc("artifacts.memo_store")
+    gc_stale_tmp()
+    return True
+
+
+def memo_load(key: str) -> Optional[List[np.ndarray]]:
+    """Probe the shared lane.  A corrupt blob is evicted and counted —
+    the caller recomputes; the tier never raises."""
+    if not memo_shared_enabled():
+        return None
+    path = _memo_path(key)
+    raw = load_blob(path)
+    if raw is None:
+        with _lock:
+            stats["memo_misses"] += 1
+        _registry.inc("artifacts.memo_miss")
+        return None
+    try:
+        with np.load(io.BytesIO(raw), allow_pickle=False) as z:
+            arrays = [z[f"out{i}"] for i in range(len(z.files))]
+    except Exception:  # noqa: BLE001 — torn/corrupt blob means dead writer
+        with _lock:
+            stats["memo_corrupt"] += 1
+        _registry.inc("artifacts.memo_corrupt")
+        evict(path)
+        return None
+    with _lock:
+        stats["memo_hits"] += 1
+    _registry.inc("artifacts.memo_hit")
+    return arrays
+
+
+def snapshot() -> dict:
+    with _lock:
+        d = dict(stats)
+    d["dir"] = _state["dir"]
+    d["armed"] = _state["dir"] is not None
+    d["memo_shared"] = memo_shared_enabled()
+    d["memo_shared_max_bytes"] = memo_shared_max_bytes()
+    return d
+
+
+def reset() -> None:
+    """Tests: zero counters and re-read the environment."""
+    with _lock:
+        for k in stats:
+            stats[k] = 0
+        _state["dir"] = None
+    configure()

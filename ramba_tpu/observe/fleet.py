@@ -162,7 +162,10 @@ def publish(directory: Optional[str] = None) -> Optional[str]:
     }
     os.makedirs(d, exist_ok=True)
     path = os.path.join(d, doc["replica"] + ".json")
-    tmp = f"{path}.{os.getpid()}.tmp"
+    # seq in the tmp name: concurrent publishes from the same process
+    # (background publisher thread + a direct publish() call) must not
+    # share a staging file, or one thread's os.replace steals the other's
+    tmp = f"{path}.{os.getpid()}.{seq}.tmp"
     with open(tmp, "w") as f:
         json.dump(doc, f, default=str)
     os.replace(tmp, path)  # collectors never see a torn live document
@@ -178,7 +181,11 @@ def _signals() -> dict:
     Every key is always present (a router must read green as green)."""
     out = {"brownout": "green", "open_breakers": [], "breaker_trips": 0,
            "shed_total": 0, "slo_breached": [], "heartbeat_running": False,
-           "heartbeat_age_s": None, "heartbeat_interval_s": None}
+           "heartbeat_age_s": None, "heartbeat_interval_s": None,
+           # serving endpoint (host:port) when this process is a fleet
+           # replica server — how the router joins a spool snapshot to
+           # the connection it routes to (fleet/replica.py exports it)
+           "endpoint": os.environ.get("RAMBA_FLEET_ENDPOINT") or None}
     try:
         from ramba_tpu.serve import overload as _overload
 
@@ -356,51 +363,68 @@ def classify(entry: dict, now: Optional[float] = None) -> tuple:
     return HEALTHY, "fresh snapshot, green signals"
 
 
+def _ingest(d: Optional[str], entries: list,
+            now: Optional[float] = None) -> tuple:
+    """One classify pass over loaded spool entries → ``(health,
+    fresh_docs)``.  The single place health semantics live: both the
+    collector and the router (``fleet.poll``) build on this, so they
+    cannot drift on what healthy/degraded/stale/dead mean."""
+    replicas: dict = {}
+    counts = {s: 0 for s in _SEVERITY}
+    fresh: dict = {}
+    for entry in entries:
+        state, reason = classify(entry, now=now)
+        counts[state] += 1
+        doc = entry.get("doc") or {}
+        published = doc.get("published_at")
+        age = None
+        if isinstance(published, (int, float)):
+            age = round((now if now is not None else time.time())
+                        - published, 3)
+        replicas[entry["replica"]] = {
+            "state": state,
+            "reason": reason,
+            "age_s": age,
+            "interval_s": doc.get("interval_s"),
+            "publish_seq": doc.get("publish_seq"),
+            "identity": doc.get("identity"),
+            "signals": doc.get("signals"),
+        }
+        # aggregatable docs: stale/dead numbers would double-count a
+        # replica against its own successor or drag in a corpse
+        if state in (HEALTHY, DEGRADED):
+            fresh[entry["replica"]] = entry["doc"]
+    fleet_state = next((s for s in _SEVERITY if counts[s]), HEALTHY)
+    return ({"dir": d, "replicas": replicas, "counts": counts,
+             "fleet_state": fleet_state}, fresh)
+
+
+def _load_entries(d: Optional[str]) -> list:
+    return load_spool(d) if d is not None and os.path.isdir(d) else []
+
+
 def health(directory: Optional[str] = None,
            now: Optional[float] = None) -> dict:
     """The router-facing fleet health verdict (see module docstring)."""
     d = directory or fleet_dir()
-    replicas: dict = {}
-    counts = {s: 0 for s in _SEVERITY}
-    if d is not None and os.path.isdir(d):
-        for entry in load_spool(d):
-            state, reason = classify(entry, now=now)
-            counts[state] += 1
-            doc = entry.get("doc") or {}
-            published = doc.get("published_at")
-            age = None
-            if isinstance(published, (int, float)):
-                age = round((now if now is not None else time.time())
-                            - published, 3)
-            replicas[entry["replica"]] = {
-                "state": state,
-                "reason": reason,
-                "age_s": age,
-                "interval_s": doc.get("interval_s"),
-                "publish_seq": doc.get("publish_seq"),
-                "identity": doc.get("identity"),
-                "signals": doc.get("signals"),
-            }
-    fleet_state = next((s for s in _SEVERITY if counts[s]), HEALTHY)
-    return {"dir": d, "replicas": replicas, "counts": counts,
-            "fleet_state": fleet_state}
+    return _ingest(d, _load_entries(d), now=now)[0]
+
+
+def poll(directory: Optional[str] = None,
+         now: Optional[float] = None) -> dict:
+    """One spool read → ``{"dir", "health", "rollup"}``.  The shared
+    load/classify/aggregate path: ``fleet_collector.py --watch`` renders
+    from it each tick and the router's health feed consumes it, so the
+    two cannot disagree about a replica's state — and the spool files
+    are read exactly once per tick instead of once per question."""
+    d = directory or fleet_dir()
+    h, fresh = _ingest(d, _load_entries(d), now=now)
+    return {"dir": d, "health": h, "rollup": _rollup_of(d, fresh)}
 
 
 # ---------------------------------------------------------------------------
 # collector: fleet rollups
 # ---------------------------------------------------------------------------
-
-
-def _fresh_docs(directory: str, now: Optional[float] = None) -> dict:
-    """replica -> doc for every replica whose snapshot is aggregatable
-    (healthy or degraded — stale/dead numbers would double-count a
-    replica against its own successor or drag in a corpse)."""
-    out = {}
-    for entry in load_spool(directory):
-        state, _reason = classify(entry, now=now)
-        if state in (HEALTHY, DEGRADED):
-            out[entry["replica"]] = entry["doc"]
-    return out
 
 
 def rollup(directory: Optional[str] = None,
@@ -419,8 +443,13 @@ def rollup(directory: Optional[str] = None,
       the replica that reported them.
     """
     d = directory or fleet_dir()
-    docs = _fresh_docs(d, now=now) if d and os.path.isdir(d) else {}
+    _h, docs = _ingest(d, _load_entries(d), now=now)
+    return _rollup_of(d, docs)
 
+
+def _rollup_of(d: Optional[str], docs: dict) -> dict:
+    """The aggregation body of :func:`rollup`, over already-loaded
+    fresh documents (shared with :func:`poll`)."""
     # -- per-tenant SLO merge ------------------------------------------------
     per_metric: dict = {}  # metric -> tenant -> [summary, ...]
     for doc in docs.values():
@@ -519,7 +548,8 @@ def render(directory: Optional[str] = None,
     from ramba_tpu.observe.telemetry import _Families, _fmt
 
     fams = _Families({})
-    h = health(directory, now=now)
+    polled = poll(directory, now=now)
+    h, roll = polled["health"], polled["rollup"]
     for state in _SEVERITY:
         fams.add("ramba_fleet_replicas", "gauge", h["counts"][state],
                  {"state": state})
@@ -541,7 +571,6 @@ def render(directory: Optional[str] = None,
                 "start_time": ident.get("start_time_wall", ""),
                 "schema_version": ident.get("schema_version", ""),
             })
-    roll = rollup(directory, now=now)
     for rep, row in sorted(roll["goodput"]["replicas"].items()):
         lab = {"replica": rep}
         fams.add("ramba_fleet_flushes_total", "counter",

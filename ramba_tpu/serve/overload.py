@@ -847,6 +847,37 @@ def health_signals() -> dict:
     }
 
 
+def admission_verdict(tenant: Optional[str] = None) -> dict:
+    """Would a submit for ``tenant`` be admitted right now?  The
+    replica server (``fleet/replica.py``) answers router pings with
+    this so the router can redirect *before* sending work, not just
+    after a refusal.  Read-only: unlike :func:`admit_submit` it never
+    transitions a breaker to half-open or burns its probe slot —
+    routing probes must not perturb the admission state they observe."""
+    with _brownout.lock:
+        brown = _brownout.state
+    with _breaker_lock:
+        snaps = {t: b.snapshot() for t, b in _breakers.items()}
+    reasons = []
+    if brown == RED:
+        reasons.append("brownout_red")
+    breaker = None
+    if tenant is not None:
+        snap = snaps.get(tenant)
+        breaker = snap["state"] if snap else CLOSED
+        if breaker == OPEN:
+            reasons.append("breaker_open")
+    open_breakers = sorted(t for t, s in snaps.items()
+                           if s["state"] == OPEN)
+    return {
+        "accepting": not reasons,
+        "reasons": reasons,
+        "brownout": brown,
+        "breaker": breaker,
+        "open_breakers": open_breakers,
+    }
+
+
 def report() -> dict:
     """Machine-readable overload rollup for diagnostics: brownout state
     + transitions, per-tenant breaker states, shed/hedge counters."""

@@ -49,9 +49,12 @@ def _pct(v):
     return "-" if v is None else f"{v:.1f}ms"
 
 
-def print_report(directory: str, file=None) -> int:
+def print_report(directory: str, file=None, polled=None) -> int:
     file = file or sys.stdout
-    h = fleet.health(directory)
+    # one spool read per tick: health and rollup come from the same
+    # fleet.poll() pass the router consumes, so the two cannot drift
+    polled = polled or fleet.poll(directory)
+    h = polled["health"]
     print(f"== fleet {directory} ({len(h['replicas'])} replica(s), "
           f"fleet_state={h['fleet_state']}) ==", file=file)
     if not h["replicas"]:
@@ -67,7 +70,7 @@ def print_report(directory: str, file=None) -> int:
         print(f"  {rep:<32s} {row['state']:<9s} {age:>8s} {seq:>6s}  "
               f"{row['reason']}", file=file)
 
-    roll = fleet.rollup(directory)
+    roll = polled["rollup"]
     gp = roll["goodput"]
     print(f"goodput (over {len(roll['replicas'])} fresh replica(s)): "
           f"flushes={gp['flushes']} nodes={gp['nodes_flushed']} "
@@ -102,17 +105,17 @@ def print_report(directory: str, file=None) -> int:
 
 
 def run_once(args) -> int:
+    polled = fleet.poll(args.fleet_dir)
     if args.json:
-        out = {"health": fleet.health(args.fleet_dir),
-               "rollup": fleet.rollup(args.fleet_dir)}
+        out = {"health": polled["health"], "rollup": polled["rollup"]}
         json.dump(out, sys.stdout, indent=2, default=str)
         print()
         rc = (_EXIT[out["health"]["fleet_state"]]
               if out["health"]["replicas"] else 4)
     elif args.prom and not args.prom_also_report:
-        rc = _EXIT[fleet.health(args.fleet_dir)["fleet_state"]]
+        rc = _EXIT[polled["health"]["fleet_state"]]
     else:
-        rc = print_report(args.fleet_dir)
+        rc = print_report(args.fleet_dir, polled=polled)
     if args.prom == "-":
         sys.stdout.write(fleet.render(args.fleet_dir))
     elif args.prom:

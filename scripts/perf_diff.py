@@ -58,6 +58,9 @@ _METRIC_DIRECTION = {
     "observe_flush_overhead_pct": "lower",
     "observe_scrape_ms": "lower",
     "fleet_snapshot_ms": "lower",       # one spool-document publish
+    "router_overhead_ms": "lower",      # per-step router+transport tax
+    "cross_replica_aot_hit_rate": "higher",  # shared-tier warm start
+    "failover_heal_ms": "lower",        # kill -> redirect -> replay heal
     "coherence_overhead_ms": "lower",   # loopback agreement-round floor
     "reshard_gb_per_s": "higher",       # staged layout-change collectives
     "reshard_peak_live_bytes": "lower",  # ledger peak during the reshard

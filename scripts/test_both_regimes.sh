@@ -38,3 +38,5 @@ echo "=== leg 16: critical-path attribution (2-rank lockstep stage waterfalls, r
 python scripts/two_process_suite.py --attrib-leg
 echo "=== leg 17: fleet observability federation (3 publishers + collector, kill-mid-soak) ==="
 python scripts/two_process_suite.py --fleet-leg
+echo "=== leg 18: fleet serving plane (router + replicas, shared artifact tier, kill-mid-soak failover) ==="
+python scripts/two_process_suite.py --router-leg

@@ -656,6 +656,33 @@ def _merge_line(e: dict) -> str:
                 f" queue={e.get('queue_ratio', '?')}"
                 f" mem={e.get('memory_frac', '?')}"
                 f" slo_breached={e.get('slo_breached', '?')}")
+    if t == "redirect":
+        sid = str(e.get("sid") or "?")
+        return (f"redirect  {e.get('reason', '?')}"
+                f" sid={sid[:8]}"
+                f" {e.get('from', '?')}->{e.get('to') or '(reroute)'}"
+                f" class={e.get('classification', '?')}"
+                + (f" tenant={e['tenant']}" if e.get("tenant") else ""))
+    if t == "heal":
+        sid = str(e.get("sid") or "?")
+        return (f"heal      {e.get('how', '?')} sid={sid[:8]}"
+                f" {e.get('from', '?')}->{e.get('to', '?')}"
+                f" replayed={e.get('steps_replayed', '?')}"
+                f" wall={e.get('wall_ms', '?')}ms"
+                + (f" tenant={e['tenant']}" if e.get("tenant") else ""))
+    if t == "migrate":
+        sid = str(e.get("sid") or "?")
+        line = f"migrate   {e.get('action', '?')} sid={sid[:8]}"
+        if e.get("from") or e.get("to"):
+            line += f" {e.get('from', '?')}->{e.get('to', '?')}"
+        if e.get("wall_ms") is not None:
+            line += f" wall={e['wall_ms']}ms"
+        if e.get("tenant"):
+            line += f" tenant={e['tenant']}"
+        return line
+    if t == "replica":
+        return (f"replica   {e.get('action', '?')}"
+                f" {e.get('endpoint', '?')}")
     return t
 
 
@@ -728,7 +755,8 @@ def merge_report(path: str, per_rank: dict, file=None, cap: int = 80) -> None:
         if t in ("fault", "degrade", "slow_flush", "cache_evict",
                  "flush_error", "health", "serve_coalesce", "stall",
                  "lifecycle", "coherence", "reshard", "shed", "breaker",
-                 "hedge", "brownout"):
+                 "hedge", "brownout", "redirect", "heal", "migrate",
+                 "replica"):
             return True
         if t == "memory":
             return not (e.get("action") == "admit" and e.get("ok"))

@@ -130,6 +130,21 @@ mid-soak flagged dead within 2x the publish interval, and the
 cross-process ``trace_report.py --trace`` chain stitched over the
 per-replica trace directories.
 
+``--router-leg`` runs the fleet serving-plane acceptance leg (PR 17):
+a router process (its own RAMBA_TRACE stream) drives replica servers
+spawned via ``scripts/fleet_router.py`` against one snapshot spool and
+one shared artifact tier.  Phase 1 warms the tier from a cold replica
+(demand compiles + ``persist.save_topk``) and pins the no-fault
+reference digest; phase 2 proves a second cold replica comes up warm
+off the shared AOT tier (cross-writer persist hits, byte-identical
+digests, shared memo lane off); phase 3 proves the shared memo lane
+(cross-replica memo hits, near-zero demand compiles); phase 4 SIGKILLs
+the replica serving a tenant mid-soak and asserts the router trips its
+fleet breaker, redirects, heals the tenant by deterministic replay on
+the survivor, and every tenant's digest stays byte-identical.  The
+stitched ``trace_report.py --merge-ranks`` / ``--trace`` views over the
+router + replica trace files must show the redirect/heal chain.
+
 ``--memo-leg`` runs the result-memoization acceptance leg: both ranks
 under ``RAMBA_MEMO=1`` canonicalize the same program (including its
 commutative-operand swap — ``analyze.canonicalize`` must produce the
@@ -1534,6 +1549,296 @@ def run_fleet_leg() -> int:
     return 0 if ok else 1
 
 
+# Router-leg driver (PR 17): runs in its OWN subprocess so the router's
+# redirect/heal events stream into a dedicated RAMBA_TRACE file that the
+# stitched trace view can interleave with the replicas'.  Spawns replica
+# servers via scripts/fleet_router.py and walks the serving plane through
+# four phases, printing one ROUTER_* marker line per phase for the leg
+# runner to assert on.  argv: <traces_dir>.
+_ROUTER_DRIVER = """
+import os
+import sys
+import time
+
+traces = sys.argv[1]
+sys.path.insert(0, os.path.join(os.environ["PYTHONPATH"], "scripts"))
+import fleet_router
+
+from ramba_tpu.fleet.router import Router
+
+TRACE = "deadbeefcafe0001"
+SEQ = [("init", {"name": "x", "shape": [256], "fill": 2.0})] + [
+    ("affine", {"name": "x", "a": 1.01, "b": float(i)}) for i in range(4)]
+
+
+def spawn(idx, extra=None):
+    tdir = os.path.join(traces, "replica%d" % idx)
+    os.makedirs(tdir, exist_ok=True)
+    env = {"RAMBA_TRACE": os.path.join(tdir, "trace.jsonl")}
+    env.update(extra or {})
+    return fleet_router.spawn_replica(env)
+
+
+def run_session(router, tenant, trace_id=None):
+    sid = router.open_session(tenant=tenant, trace_id=trace_id)
+    for w, p in SEQ:
+        router.step(sid, w, p)
+    digest = router.step(sid, "digest")["result"]
+    router.close_session(sid)
+    return digest
+
+
+def stop(router, *procs):
+    router.shutdown_fleet()
+    for p in procs:
+        try:
+            p.wait(timeout=30)
+        except Exception:
+            p.kill()
+
+
+# phase 1: one cold replica pays every compile, fills the shared tier,
+# and defines the no-fault reference digest (the workload registry is
+# deterministic, so this digest is THE answer for every later phase)
+p0, ep0 = spawn(0)
+r0 = Router(endpoints=[ep0])
+ref = [run_session(r0, t) for t in ("acme", "globex")]
+assert len(set(ref)) == 1, ref
+c0 = r0.call_replica(ep0, "stats")["counters"]
+saved = r0.call_replica(ep0, "save_artifacts", k=16)["saved"]
+stop(r0, p0)
+print("ROUTER_REF digest=%s compiles=%d aot_stored=%d" % (
+    ref[0], c0["fuser.compiles"], saved.get("stored", 0)), flush=True)
+
+# phase 2: cold process, shared AOT tier on but the shared memo lane
+# OFF -- every flush demand-compiles, and the compiler must be fed by
+# replica 0's persisted executables (cross-writer AOT hits)
+p1, ep1 = spawn(1, {"RAMBA_MEMO_SHARED": "0"})
+r1 = Router(endpoints=[ep1])
+d1 = [run_session(r1, t) for t in ("acme", "globex")]
+c1 = r1.call_replica(ep1, "stats")["counters"]
+stop(r1, p1)
+print("ROUTER_WARM_AOT ok=%d cross=%d compiles=%d" % (
+    int(d1 == ref), c1["compile.persist_cross_hit"],
+    c1["fuser.compiles"]), flush=True)
+
+# phase 3: cold process, shared memo lane ON -- flushes hit replica 0's
+# content-addressed memo blobs and skip the compiler
+p2, ep2 = spawn(2)
+r2 = Router(endpoints=[ep2])
+d2 = [run_session(r2, t) for t in ("acme", "globex")]
+c2 = r2.call_replica(ep2, "stats")["counters"]
+stop(r2, p2)
+print("ROUTER_WARM_MEMO ok=%d shared=%d compiles=%d" % (
+    int(d2 == ref), c2["memo.shared_hit"], c2["fuser.compiles"]),
+    flush=True)
+
+# phase 4: two replicas, four tenants; SIGKILL the replica serving
+# tenant acme mid-soak -- its sessions must redirect off the corpse
+# (trip the fleet breaker), heal by deterministic replay on the
+# survivor, and finish byte-identical to the phase-1 reference
+procs = {}
+p3, ep3 = spawn(3)
+p4, ep4 = spawn(4)
+procs[ep3], procs[ep4] = p3, p4
+rt = Router(endpoints=[ep3, ep4])
+tenants = ("acme", "globex", "initech", "umbrella")
+sids = {t: rt.open_session(
+            tenant=t, trace_id=(TRACE if t == "acme" else None))
+        for t in tenants}
+victim = None
+for i, (w, p) in enumerate(SEQ):
+    for t in tenants:
+        rt.step(sids[t], w, p)
+    if i == 1:
+        victim = rt.stats()["sessions"][sids["acme"]]["endpoint"]
+        procs[victim].kill()
+        procs[victim].wait(timeout=30)
+d4 = [rt.step(sids[t], "digest")["result"] for t in tenants]
+st = rt.stats()
+trips = st["replicas"][victim]["breaker"]["trips"]
+survivor = ep4 if victim == ep3 else ep3
+c4 = rt.call_replica(survivor, "stats")["counters"]
+stop(rt, procs[survivor])
+print("ROUTER_HEAL ok=%d redirects=%d heals=%d trips=%d "
+      "surv_shared=%d trace=%s" % (
+          int(all(d == ref[0] for d in d4)), st["redirects"],
+          st["heals"], trips, c4["memo.shared_hit"], TRACE), flush=True)
+print("ROUTER_DRIVER_OK", flush=True)
+"""
+
+
+def run_router_leg() -> int:
+    """Fleet serving-plane acceptance (PR 17): a router process drives
+    five replica servers (spawned/killed across four phases) against one
+    snapshot spool + shared artifact tier.  Asserts (a) a cold replica
+    compiles and persists, (b) a second cold replica comes up WARM off
+    the shared AOT tier (cross-writer persist hits, byte-identical
+    digests), (c) a third comes up warm off the shared memo lane with
+    near-zero demand compiles, (d) a replica SIGKILLed mid-soak trips
+    the router's fleet breaker, its tenants redirect + heal by replay
+    onto the survivor with byte-identical digests, and (e) the stitched
+    trace over router + replica trace files tells the redirect/heal
+    story."""
+    basetemp = tempfile.mkdtemp(prefix="ramba_2proc_router_")
+    fleet_dir = os.path.join(basetemp, "fleet")
+    traces = os.path.join(basetemp, "traces")
+    budget = float(os.environ.get("RAMBA_TEST_PROCS_TIMEOUT", "900"))
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    for k in ("RAMBA_TEST_PROCS", "RAMBA_TEST_PROC_ID",
+              "RAMBA_TEST_COORD", "RAMBA_TEST_SHARED_TMP",
+              "RAMBA_PROFILE_DIR", "RAMBA_FAULTS", "RAMBA_HBM_BUDGET",
+              "RAMBA_METRICS_PORT", "RAMBA_METRICS_FILE",
+              "RAMBA_FLIGHT_DIR", "RAMBA_FLEET_DIR",
+              "RAMBA_FLEET_INTERVAL_S", "RAMBA_FLEET_STALE_X",
+              "RAMBA_FLEET_DEAD_X", "RAMBA_FLEET_ENDPOINT",
+              "RAMBA_FLEET_AUTHKEY", "RAMBA_ARTIFACTS", "RAMBA_CACHE",
+              "RAMBA_AOT", "RAMBA_MEMO", "RAMBA_MEMO_SHARED",
+              "RAMBA_MEMO_SHARED_MAX", "RAMBA_HANDOFF_DIR",
+              "RAMBA_ROUTER_TIMEOUT_S", "RAMBA_ROUTER_HEDGE",
+              "RAMBA_ROUTER_HEDGE_FACTOR", "RAMBA_ROUTER_MAX_REDIRECTS",
+              "RAMBA_BREAKER_THRESHOLD", "RAMBA_TRACE"):
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["RAMBA_FLEET_DIR"] = fleet_dir
+    env["RAMBA_FLEET_INTERVAL_S"] = "0.2"
+    env["RAMBA_ARTIFACTS"] = os.path.join(basetemp, "artifacts")
+    env["RAMBA_CACHE"] = os.path.join(basetemp, "aot")  # shared AOT tier
+    env["RAMBA_MEMO"] = "1"
+    env["RAMBA_BREAKER_THRESHOLD"] = "1"  # first failure trips
+    env["RAMBA_ROUTER_TIMEOUT_S"] = "10"
+    rdir = os.path.join(traces, "router")
+    os.makedirs(rdir, exist_ok=True)
+    env["RAMBA_TRACE"] = os.path.join(rdir, "trace.jsonl")
+
+    log_path = os.path.join(basetemp, "driver.log")
+    with open(log_path, "w") as log:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _ROUTER_DRIVER, traces],
+            env=env, stdout=log, stderr=subprocess.STDOUT, cwd=REPO)
+        try:
+            rc = proc.wait(timeout=budget)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            rc = -9
+    with open(log_path) as f:
+        lines = f.read().splitlines()
+    marks = {}
+    for ln in lines:
+        if ln.startswith("ROUTER_"):
+            parts = ln.split()
+            marks[parts[0]] = dict(
+                kv.split("=", 1) for kv in parts[1:] if "=" in kv)
+
+    ok = rc == 0 and "ROUTER_DRIVER_OK" in marks
+    if not ok:
+        print(f"router leg: FAIL (driver rc={rc}, markers "
+              f"{sorted(marks)})")
+        print("\n".join(lines[-60:]))
+
+    def _ints(mark):
+        return {k: int(v) for k, v in marks[mark].items()
+                if v.lstrip("-").isdigit()}
+
+    if ok:
+        ref = _ints("ROUTER_REF")
+        if ref["compiles"] == 0 or ref["aot_stored"] == 0:
+            print(f"router leg: FAIL (cold replica should compile and "
+                  f"persist, got {marks['ROUTER_REF']})")
+            ok = False
+        else:
+            print(f"router leg: cold replica paid {ref['compiles']} "
+                  f"compiles, persisted {ref['aot_stored']} AOT blobs, "
+                  f"reference digest {marks['ROUTER_REF']['digest'][:16]}")
+
+    if ok:
+        aot = _ints("ROUTER_WARM_AOT")
+        if not aot["ok"] or aot["cross"] == 0:
+            print(f"router leg: FAIL (AOT-warm replica: want "
+                  f"byte-identical digests + cross-writer persist hits, "
+                  f"got {marks['ROUTER_WARM_AOT']})")
+            ok = False
+        else:
+            print(f"router leg: replica 2 warm off the shared AOT tier "
+                  f"({aot['cross']} cross-writer hits, "
+                  f"{aot['compiles']} demand compiles, digests match)")
+
+    if ok:
+        memo = _ints("ROUTER_WARM_MEMO")
+        if (not memo["ok"] or memo["shared"] == 0
+                or memo["compiles"] >= ref["compiles"]):
+            print(f"router leg: FAIL (memo-warm replica: want "
+                  f"byte-identical digests, >0 shared memo hits, fewer "
+                  f"compiles than cold ({ref['compiles']}), got "
+                  f"{marks['ROUTER_WARM_MEMO']})")
+            ok = False
+        else:
+            print(f"router leg: replica 3 warm off the shared memo lane "
+                  f"({memo['shared']} cross-replica memo hits, "
+                  f"{memo['compiles']} vs cold {ref['compiles']} demand "
+                  f"compiles, digests match)")
+
+    if ok:
+        heal = _ints("ROUTER_HEAL")
+        if (not heal["ok"] or heal["redirects"] == 0
+                or heal["heals"] == 0 or heal["trips"] == 0):
+            print(f"router leg: FAIL (kill mid-soak: want byte-identical "
+                  f"digests + redirects + heals + breaker trips, got "
+                  f"{marks['ROUTER_HEAL']})")
+            ok = False
+        else:
+            print(f"router leg: SIGKILL mid-soak healed "
+                  f"({heal['redirects']} redirects, {heal['heals']} "
+                  f"replay heals, {heal['trips']} breaker trips, "
+                  f"survivor made {heal['surv_shared']} shared memo "
+                  f"hits, all 4 tenants byte-identical)")
+
+    # stitched trace: router + replica files interleave, and the
+    # redirect/heal story is visible in the merged noteworthy stream
+    if ok:
+        merged = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "trace_report.py"),
+             traces, "--merge-ranks"],
+            capture_output=True, text=True, cwd=REPO)
+        if (merged.returncode != 0 or "redirect" not in merged.stdout
+                or "heal" not in merged.stdout):
+            print(f"router leg: FAIL (--merge-ranks rc="
+                  f"{merged.returncode} must show the redirect/heal "
+                  f"story)")
+            print(merged.stdout[-2000:] + merged.stderr[-2000:])
+            ok = False
+        else:
+            note = [ln for ln in merged.stdout.splitlines()
+                    if "redirect" in ln or "heal" in ln]
+            print("router leg: stitched trace shows the failover story:")
+            print("\n".join(f"  {ln.strip()}" for ln in note[:6]))
+
+    if ok:
+        chain = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "trace_report.py"),
+             traces, "--trace", marks["ROUTER_HEAL"]["trace"]],
+            capture_output=True, text=True, cwd=REPO)
+        if chain.returncode != 0 or "process(es)" not in chain.stdout:
+            print(f"router leg: FAIL (--trace "
+                  f"{marks['ROUTER_HEAL']['trace']} rc="
+                  f"{chain.returncode})")
+            print(chain.stdout[-2000:] + chain.stderr[-2000:])
+            ok = False
+        else:
+            head = chain.stdout.splitlines()[0]
+            print(f"router leg: {head.strip()}")
+
+    print(f"router leg: {'OK' if ok else 'FAIL'}")
+    if ok:
+        shutil.rmtree(basetemp, ignore_errors=True)
+    return 0 if ok else 1
+
+
 def run_perf_leg() -> int:
     """Two ranks under RAMBA_PERF=1; both ledgers must report the same
     kernel fingerprint set, and the merged timeline must build."""
@@ -2602,6 +2907,8 @@ def main() -> int:
         return run_telemetry_leg()
     if "--fleet-leg" in sys.argv[1:]:
         return run_fleet_leg()
+    if "--router-leg" in sys.argv[1:]:
+        return run_router_leg()
     if "--autotune-leg" in sys.argv[1:]:
         return run_autotune_leg()
     if "--memo-leg" in sys.argv[1:]:

@@ -1,0 +1,425 @@
+"""Fleet serving plane (PR 17): shared artifact tier, migration, router.
+
+Covers the library seams of ``ramba_tpu/fleet/``:
+
+* the shared artifact tier's race discipline — atomic tmp+rename
+  publish, cross-PROCESS two-writer race with a concurrent reader that
+  must never observe a torn blob, dead-writer temp GC, corruption on
+  read evicted and recomputed (never raised), the content-addressed
+  memo key, and the size cap,
+* session migration: ``export_session`` / ``adopt_session`` round-trip
+  through the PR-7 checkpoint format, manifest validation, discard,
+* the ``redirect`` rung in ``retry.classify`` — fleet errors are
+  retryable *elsewhere*, while in-process sheds stay fatal,
+* ``observe.fleet.poll()`` — the single load/classify/rollup pass the
+  collector and the router both consume, endpoint signal included,
+* ``overload.admission_verdict`` — the read-only router probe that
+  must not perturb breaker state, and
+* the Router against REAL in-process replica servers: placement,
+  refusal redirect (which must NOT feed the fleet breaker), and
+  kill-mid-session heal-by-replay with byte-identical digests.
+
+The full multi-process soak (router process + replica subprocesses +
+SIGKILL + stitched traces) is scripts/two_process_suite.py --router-leg;
+these tests pin the library logic in-process.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ramba_tpu as rt
+from ramba_tpu.fleet import artifacts, migrate
+from ramba_tpu.fleet.router import (NoHealthyReplica, ReplicaRefusal,
+                                    ReplicaUnavailable, Router)
+from ramba_tpu.observe import fleet, registry
+from ramba_tpu.resilience import retry
+from ramba_tpu.serve import overload
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for k in ("RAMBA_ARTIFACTS", "RAMBA_MEMO_SHARED",
+              "RAMBA_MEMO_SHARED_MAX", "RAMBA_HANDOFF_DIR",
+              "RAMBA_FLEET_DIR", "RAMBA_FLEET_ENDPOINT",
+              "RAMBA_ROUTER_HEDGE", "RAMBA_BREAKER_THRESHOLD"):
+        monkeypatch.delenv(k, raising=False)
+    artifacts.reset()
+    overload.reset()
+    yield
+    artifacts.reset()
+    overload.reset()
+
+
+# ---------------------------------------------------------------------------
+# shared artifact tier
+# ---------------------------------------------------------------------------
+
+
+def test_store_blob_atomic_roundtrip(tmp_path):
+    p = str(tmp_path / "blob.bin")
+    assert artifacts.store_blob(p, b"one")
+    assert artifacts.load_blob(p) == b"one"
+    assert artifacts.store_blob(p, b"two")  # replace, not append
+    assert artifacts.load_blob(p) == b"two"
+    assert artifacts.load_blob(str(tmp_path / "missing")) is None
+    # no staging debris after successful publishes
+    assert not [n for n in os.listdir(tmp_path) if n.startswith(".tmp-")]
+
+
+def test_memo_roundtrip_and_stats(tmp_path):
+    artifacts.configure(str(tmp_path))
+    outs = [np.arange(8, dtype=np.float32), np.ones((2, 3))]
+    assert artifacts.memo_store("k" * 32, outs)
+    got = artifacts.memo_load("k" * 32)
+    assert len(got) == 2
+    np.testing.assert_array_equal(got[0], outs[0])
+    np.testing.assert_array_equal(got[1], outs[1])
+    assert artifacts.memo_load("m" * 32) is None  # miss
+    snap = artifacts.snapshot()
+    assert snap["memo_stores"] == 1
+    assert snap["memo_hits"] == 1
+    assert snap["memo_misses"] == 1
+
+
+def test_memo_corruption_evicted_never_raised(tmp_path):
+    artifacts.configure(str(tmp_path))
+    artifacts.memo_store("c" * 32, [np.arange(4)])
+    path = os.path.join(str(tmp_path), "memo", "c" * 32 + ".npz")
+    with open(path, "wb") as f:
+        f.write(b"not an npz at all")
+    assert artifacts.memo_load("c" * 32) is None  # evict + recompute
+    assert not os.path.exists(path)
+    assert artifacts.snapshot()["memo_corrupt"] == 1
+
+
+def test_memo_size_cap(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAMBA_MEMO_SHARED_MAX", "64")
+    artifacts.configure(str(tmp_path))
+    assert not artifacts.memo_store("b" * 32, [np.zeros(1024)])
+    assert artifacts.snapshot()["memo_skipped_large"] == 1
+    # content_key refuses over-cap inputs too (hashing them is the cost)
+    assert artifacts.content_key("ch", [np.zeros(1024)], "fp") is None
+
+
+def test_content_key_binds_bytes_not_identity(tmp_path):
+    artifacts.configure(str(tmp_path))
+    a = np.arange(16, dtype=np.float64)
+    k1 = artifacts.content_key("chash", [a, ("scalar", 2.0)], "fp")
+    k2 = artifacts.content_key("chash", [a.copy(), ("scalar", 2.0)], "fp")
+    assert k1 == k2  # same bytes, different buffers
+    b = a.copy()
+    b[3] += 1.0
+    assert artifacts.content_key("chash", [b, ("scalar", 2.0)], "fp") != k1
+    assert artifacts.content_key("other", [a, ("scalar", 2.0)], "fp") != k1
+    assert artifacts.content_key("chash", [a, ("scalar", 2.0)], "fp2") != k1
+
+
+def test_gc_stale_tmp_sweeps_dead_writers(tmp_path):
+    artifacts.configure(str(tmp_path))
+    memo_dir = os.path.join(str(tmp_path), "memo")
+    dead = os.path.join(memo_dir, ".tmp-deadwriter")
+    with open(dead, "w") as f:
+        f.write("partial")
+    old = time.time() - 3600
+    os.utime(dead, (old, old))
+    fresh = os.path.join(memo_dir, ".tmp-livewriter")
+    with open(fresh, "w") as f:
+        f.write("partial")
+    assert artifacts.gc_stale_tmp(max_age_s=300.0) == 1
+    assert not os.path.exists(dead)
+    assert os.path.exists(fresh)  # a live writer's staging file survives
+    assert artifacts.snapshot()["tmp_gcd"] == 1
+
+
+def test_disarmed_tier_is_inert(tmp_path):
+    # no RAMBA_ARTIFACTS: every call degrades to a no-op, never raises
+    assert not artifacts.armed()
+    assert not artifacts.memo_store("k" * 32, [np.arange(4)])
+    assert artifacts.memo_load("k" * 32) is None
+    assert artifacts.handoff_dir() is None
+    assert artifacts.gc_stale_tmp() == 0
+
+
+_RACE_WRITER = """
+import os, sys, time
+import numpy as np
+from ramba_tpu.fleet import artifacts
+d, val, n, go = sys.argv[1], float(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+artifacts.configure(d)
+while not os.path.exists(go):
+    time.sleep(0.01)
+for i in range(n):
+    assert artifacts.memo_store("racekey" + "0" * 25,
+                                [np.full(2048, val)])
+print("WRITER_DONE", flush=True)
+"""
+
+
+def test_cross_process_write_race(tmp_path):
+    """Two subprocess writers hammer the SAME memo key while this
+    process reads it concurrently: every read must be a complete blob
+    from one writer or the other (or a miss) — never torn, never a
+    corruption eviction — and no staging temp survives the race."""
+    artifacts.configure(str(tmp_path))
+    go = str(tmp_path / "go")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    writers = [
+        subprocess.Popen([sys.executable, "-c", _RACE_WRITER,
+                          str(tmp_path), val, "40", go],
+                         env=env, cwd=REPO, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+        for val in ("1.0", "2.0")
+    ]
+    with open(go, "w") as f:
+        f.write("go")
+    key = "racekey" + "0" * 25
+    reads = complete = 0
+    while any(w.poll() is None for w in writers):
+        got = artifacts.memo_load(key)
+        reads += 1
+        if got is not None:
+            (arr,) = got
+            assert arr.shape == (2048,)
+            v = arr[0]
+            assert v in (1.0, 2.0)
+            assert np.all(arr == v)  # one writer's payload, whole
+            complete += 1
+    outs = [w.communicate()[0] for w in writers]
+    assert all(w.returncode == 0 for w in writers), outs
+    assert all("WRITER_DONE" in o for o in outs), outs
+    # exactly one winner file, complete, and no torn read was ever seen
+    memo_dir = os.path.join(str(tmp_path), "memo")
+    blobs = [n for n in os.listdir(memo_dir) if n.endswith(".npz")]
+    assert blobs == [key + ".npz"]
+    assert complete > 0 and reads > 0
+    assert artifacts.snapshot()["memo_corrupt"] == 0
+    final = artifacts.memo_load(key)[0]
+    assert np.all(final == final[0]) and final[0] in (1.0, 2.0)
+    # any staging debris is dead-writer debris; the sweep clears it
+    artifacts.gc_stale_tmp(max_age_s=0.0)
+    assert not [n for n in os.listdir(memo_dir)
+                if n.startswith(".tmp-")]
+
+
+# ---------------------------------------------------------------------------
+# session migration
+# ---------------------------------------------------------------------------
+
+
+def test_migrate_export_adopt_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAMBA_ARTIFACTS", str(tmp_path))
+    artifacts.configure(str(tmp_path))
+    state = {"x": rt.full([32], 3.5), "y": rt.arange(8),
+             "_keep": rt.full([4], 1.0)}  # underscore = not durable
+    meta = {"tenant": "acme", "trace_id": "t1", "seq": 7}
+    path = migrate.export_session("sid-1", meta, state)
+    assert os.path.exists(path)
+    assert "sid-1" in migrate.list_handoffs()
+    manifest, adopted = migrate.adopt_session("sid-1")
+    assert manifest["tenant"] == "acme"
+    assert manifest["seq"] == 7
+    assert manifest["names"] == ["x", "y"]
+    assert set(adopted) == {"x", "y"}
+    np.testing.assert_array_equal(np.asarray(adopted["x"].asarray()),
+                                  np.full(32, 3.5))
+    np.testing.assert_array_equal(np.asarray(adopted["y"].asarray()),
+                                  np.arange(8))
+    migrate.discard("sid-1")
+    assert "sid-1" not in migrate.list_handoffs()
+    with pytest.raises(migrate.MigrateError):
+        migrate.adopt_session("sid-1")
+
+
+def test_migrate_manifest_validation(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAMBA_ARTIFACTS", str(tmp_path))
+    artifacts.configure(str(tmp_path))
+    with pytest.raises(migrate.MigrateError):
+        migrate.load_manifest("never-exported")
+    migrate.export_session("sid-2", {"seq": 1}, {"x": rt.full([4], 1.0)})
+    # a manifest claiming another sid is a placement bug, not adoptable
+    src = os.path.join(artifacts.handoff_dir(), "sid-2.manifest.json")
+    dst = os.path.join(artifacts.handoff_dir(), "sid-3.manifest.json")
+    os.rename(src, dst)
+    with pytest.raises(migrate.MigrateError):
+        migrate.load_manifest("sid-3")
+
+
+# ---------------------------------------------------------------------------
+# the redirect rung
+# ---------------------------------------------------------------------------
+
+
+def test_classify_redirect_rung():
+    assert retry.classify(
+        ReplicaRefusal("h:1", {"error": "CircuitOpenError",
+                               "classification": "breaker"})) == "redirect"
+    assert retry.classify(
+        ReplicaUnavailable("h:1", "EOFError")) == "redirect"
+    # redirect wins over shed: the replica's breaker said no, but
+    # another replica can serve the identical request
+    wrapped = ReplicaRefusal("h:1", {"error": "QueueFullError",
+                                     "classification": "queue_full"})
+    assert retry.classify(wrapped) == "redirect"
+    # in-process sheds stay fatal (never re-attempt a shed in place)...
+    assert retry.classify(
+        overload.CircuitOpenError("t", "open")) == "fatal"
+    # ...and a fully exhausted fleet has nowhere left to redirect to
+    assert retry.classify(NoHealthyReplica("all dead")) == "fatal"
+
+
+# ---------------------------------------------------------------------------
+# fleet.poll — one load/classify pass for collector AND router
+# ---------------------------------------------------------------------------
+
+
+def test_poll_is_health_plus_rollup(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAMBA_FLEET_ENDPOINT", "127.0.0.1:4242")
+    d = str(tmp_path / "spool")
+    fleet.publish(d)
+    # pin the classification clock: age_s is rounded wall-clock age, so
+    # two free-running reads milliseconds apart would differ
+    now = time.time()
+    polled = fleet.poll(d, now=now)
+    assert polled["dir"] == d
+    assert polled["health"]["counts"]["healthy"] == 1
+    ((rid, row),) = polled["health"]["replicas"].items()
+    assert row["state"] == "healthy"
+    # the router's discovery key rides the signals block
+    assert row["signals"]["endpoint"] == "127.0.0.1:4242"
+    # one classify pass: poll's health is exactly health()'s verdict
+    assert polled["health"] == fleet.health(d, now=now)
+    assert "goodput" in polled["rollup"]
+
+
+# ---------------------------------------------------------------------------
+# admission_verdict — the router's read-only probe
+# ---------------------------------------------------------------------------
+
+
+def test_admission_verdict_read_only(monkeypatch):
+    v = overload.admission_verdict("acme")
+    assert v["accepting"] and v["reasons"] == []
+    assert v["breaker"] == "closed"
+    monkeypatch.setenv("RAMBA_BREAKER_THRESHOLD", "1")
+    overload.record_outcome("acme", False)  # trips at threshold 1
+    v = overload.admission_verdict("acme")
+    assert not v["accepting"]
+    assert "breaker_open" in v["reasons"]
+    assert v["open_breakers"] == ["acme"]
+    # the probe must NOT have advanced the breaker to half-open: a
+    # routing decision is not an admission attempt
+    assert overload.breaker_for("acme").snapshot()["state"] == "open"
+
+
+# ---------------------------------------------------------------------------
+# router against real in-process replica servers
+# ---------------------------------------------------------------------------
+
+
+SEQ = [("init", {"name": "x", "shape": [64], "fill": 2.0}),
+       ("affine", {"name": "x", "a": 1.01, "b": 1.0}),
+       ("affine", {"name": "x", "a": 1.01, "b": 2.0})]
+
+
+@pytest.fixture()
+def two_servers(monkeypatch):
+    from ramba_tpu.fleet.replica import ReplicaServer
+
+    monkeypatch.setenv("RAMBA_BREAKER_THRESHOLD", "1")
+    servers, threads = [], []
+    for _ in range(2):
+        s = ReplicaServer()
+        t = threading.Thread(target=s.serve_forever, daemon=True)
+        t.start()
+        servers.append(s)
+        threads.append(t)
+    yield servers
+    for s in servers:
+        s.stop()
+    for t in threads:
+        t.join(timeout=10)
+
+
+def _run_session(router, tenant):
+    sid = router.open_session(tenant=tenant)
+    for w, p in SEQ:
+        router.step(sid, w, p)
+    digest = router.step(sid, "digest")["result"]
+    router.close_session(sid)
+    return digest
+
+
+def test_router_failover_heals_by_replay(two_servers):
+    a, b = two_servers
+    router = Router(endpoints=[a.endpoint, b.endpoint])
+    reference = _run_session(router, "acme")  # no-fault answer
+
+    redirects0 = registry.get("router.redirects")
+    heals0 = registry.get("router.heals")
+    sid = router.open_session(tenant="acme")
+    for w, p in SEQ[:2]:
+        router.step(sid, w, p)
+    victim_ep = router.stats()["sessions"][sid]["endpoint"]
+    victim = a if a.endpoint == victim_ep else b
+    victim.stop()  # in-process SIGKILL stand-in: transport goes dark
+    router.step(sid, *SEQ[2])  # redirect -> heal by replay -> serve
+    digest = router.step(sid, "digest")["result"]
+    assert digest == reference  # deterministic replay: byte-identical
+    survivor_ep = b.endpoint if victim is a else a.endpoint
+    assert router.stats()["sessions"][sid]["endpoint"] == survivor_ep
+    assert registry.get("router.redirects") > redirects0
+    assert registry.get("router.heals") > heals0
+    # the transport failure fed the fleet breaker for the dead replica
+    assert router.stats()["replicas"][victim_ep]["breaker"]["trips"] >= 1
+    router.close_session(sid)
+
+
+def test_router_refusal_redirects_without_feeding_breaker(
+        two_servers, monkeypatch):
+    a, b = two_servers
+    router = Router(endpoints=[a.endpoint, b.endpoint])
+    sid = router.open_session(tenant="globex")
+    router.step(sid, *SEQ[0])
+    first_ep = router.stats()["sessions"][sid]["endpoint"]
+
+    real = overload.admit_submit
+    refusals = {"n": 0}
+
+    def refuse_once(*, tenant=None, priority=False, **kw):
+        if refusals["n"] == 0:
+            refusals["n"] += 1
+            raise overload.ShedError("test-refusal", tenant=tenant)
+        return real(tenant=tenant, priority=priority, **kw)
+
+    monkeypatch.setattr(overload, "admit_submit", refuse_once)
+    reply = router.step(sid, *SEQ[1])  # refused on A -> healed elsewhere
+    assert reply["ok"]
+    assert refusals["n"] == 1
+    moved_ep = router.stats()["sessions"][sid]["endpoint"]
+    assert moved_ep != first_ep
+    # sheds never feed back: the refusing replica's FLEET breaker stayed
+    # closed with zero trips even at threshold 1
+    snap = router.stats()["replicas"][first_ep]["breaker"]
+    assert snap == {"state": "closed", "trips": 0, "recent_failures": 0}
+    router.close_session(sid)
+
+
+def test_router_no_healthy_replica_is_terminal(two_servers):
+    a, b = two_servers
+    router = Router(endpoints=[a.endpoint, b.endpoint])
+    sid = router.open_session(tenant="acme")
+    router.step(sid, *SEQ[0])
+    a.stop()
+    b.stop()
+    with pytest.raises(NoHealthyReplica):
+        router.step(sid, *SEQ[1])
