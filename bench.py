@@ -550,6 +550,105 @@ def _bench_memo(rt, platform):
     return out
 
 
+def _bench_plancache(rt, platform):
+    """Plan-certificate cache section (core/plancache.py,
+    RAMBA_PLANCERT).  Three numbers feed scripts/perf_diff.py:
+    ``plan_hit_rate`` (fraction of lookups redeemed on a repeated
+    program under strict verification), ``fast_path_floor_us`` (p50
+    prepare+verify on certificate hits — the host-side floor a repeat
+    flush pays after the analysis pipeline is skipped) and
+    ``plan_fast_path_speedup`` (miss-path p50 prepare+verify over the
+    hit-path p50 from the stage waterfalls; the PR-18 acceptance bar is
+    >= 10x)."""
+    import os
+
+    from ramba_tpu.core import plancache as _plancache
+    from ramba_tpu.observe import events as _events
+
+    saved_pc = os.environ.get("RAMBA_PLANCERT")
+    saved_vf = os.environ.get("RAMBA_VERIFY")
+    os.environ["RAMBA_VERIFY"] = "strict"
+    _plancache.reset()
+    out = {}
+
+    def _pv_spans(n):
+        spans = [e for e in _events.last(n + 8, type="flush")
+                 if isinstance(e.get("stages"), dict)][-n:]
+        return spans
+
+    def _p50(vals):
+        vals = sorted(vals)
+        return vals[len(vals) // 2] if vals else 0.0
+
+    try:
+        n = 262_144 if platform != "cpu" else 16_384
+        base = rt.arange(n) / 7.0
+        other = rt.arange(n) * 3.0
+        rt.sync()
+        reps = 40
+
+        def _step():
+            # A deep fused elementwise chain — the shape of repeated
+            # serving traffic the certificate exists for.  The analysis
+            # pipeline (rules, effects, canon, class proof, admission
+            # walk) is O(instrs); redemption is O(1) in program size, so
+            # the chain depth is what the fast path actually saves.
+            r = base
+            for _ in range(32):
+                r = r * 1.0001 + other
+            r = (r - base) * 0.5
+            r.asarray()
+            del r
+
+        # miss path first: full analysis pipeline every flush.  The gc
+        # sweep keeps a pending gen2 collection from landing inside
+        # either phase's p50 window.
+        import gc
+
+        os.environ["RAMBA_PLANCERT"] = "0"
+        gc.collect()
+        for _ in range(reps):
+            _step()
+        miss_pv = [
+            (s["stages"].get("prepare") or 0.0)
+            + (s["stages"].get("verify") or 0.0)
+            for s in _pv_spans(reps)
+        ]
+
+        # hit path: one certification flush, then every repeat redeems
+        os.environ["RAMBA_PLANCERT"] = "1"
+        _plancache.reset()
+        gc.collect()
+        for _ in range(reps + 1):
+            _step()
+        hit_pv = [
+            (s["stages"].get("prepare") or 0.0)
+            + (s["stages"].get("verify") or 0.0)
+            for s in _pv_spans(reps + 1)
+            if s.get("plan_cache")
+        ]
+
+        snap = _plancache.snapshot()
+        out["plan_hit_rate"] = snap["hit_rate"]
+        out["plan_entries"] = snap["entries"]
+        h50, m50 = _p50(hit_pv), _p50(miss_pv)
+        out["fast_path_floor_us"] = round(h50 * 1e6, 2)
+        if h50 > 0 and m50 > 0:
+            # the stage-waterfall assertion: prepare+verify p50 on hits
+            # must drop >= 10x vs the miss path
+            out["plan_fast_path_speedup"] = round(m50 / h50, 2)
+            out["plan_waterfall_10x"] = bool(m50 / h50 >= 10.0)
+    finally:
+        for k, v in (("RAMBA_PLANCERT", saved_pc),
+                     ("RAMBA_VERIFY", saved_vf)):
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        _plancache.reset()
+    return out
+
+
 def _bench_observe(rt, platform):
     """Observability-plane cost section (PAY-FOR-WHAT-YOU-SEE check).
     Three numbers feed scripts/perf_diff.py: ``observe_events_per_s``
@@ -1269,6 +1368,11 @@ def main():
             out.update(_bench_memo(rt, platform))
         except Exception:  # noqa: BLE001
             out["memo_error"] = traceback.format_exc(limit=2)[-300:]
+
+        try:
+            out.update(_bench_plancache(rt, platform))
+        except Exception:  # noqa: BLE001
+            out["plancache_error"] = traceback.format_exc(limit=2)[-300:]
 
         try:
             out.update(_bench_observe(rt, platform))

@@ -5,6 +5,7 @@ Usage (equivalently via ``scripts/ramba_lint.py``)::
     python -m ramba_tpu.analyze /tmp/trace.jsonl [more.jsonl ...]
     python -m ramba_tpu.analyze --json --strict trace.jsonl
     python -m ramba_tpu.analyze --memo-audit trace.jsonl
+    python -m ramba_tpu.analyze --plan-audit trace.jsonl
 
 Consumes the trace a run wrote under ``RAMBA_TRACE=<path>`` (per-rank
 ``.rank*`` siblings are auto-discovered).  Two sources of diagnostics:
@@ -261,6 +262,128 @@ def memo_audit(
     }
 
 
+def plan_audit(
+    events: Sequence[Dict[str, Any]],
+    file: Optional[TextIO] = None,
+    top: int = 10,
+) -> Dict[str, Any]:
+    """Replay a trace's ``program`` events against its recorded plan
+    certificates (``plan_cert`` events, ``analyze/plancert.py``):
+
+    * the **would-be hit rate** a plan cache (``RAMBA_PLANCERT``) gets
+      on this workload — every repeat of a certified canonical form
+      after its certification is a would-be hit;
+    * the **stale-signature causes** observed at runtime (``plan_stale``
+      events), the reason repeats re-analyzed instead of hitting;
+    * certificates whose **stored proof no longer re-derives** — the
+      effect class or canonical hash recomputed offline contradicts the
+      stored verdict, meaning a stale analysis version or a corrupted
+      certificate (these would invalidate via the ruleset field live,
+      but the audit names them explicitly)."""
+    from ramba_tpu.analyze import canon as _canon
+    from ramba_tpu.analyze import plancert as _plancert
+
+    out = file or sys.stdout
+    certs: Dict[str, Any] = {}
+    for ev in events:
+        if ev.get("type") != "plan_cert":
+            continue
+        cert = _plancert.from_payload(ev)
+        if cert is not None and cert.chash is not None:
+            certs.setdefault(cert.chash, cert)
+
+    total = unreadable = covered = would_hits = live_hits = 0
+    # each certificate's own certification flush is its first
+    # occurrence: every covered repeat after it is a would-be hit
+    seen: Counter = Counter({ch: 1 for ch in certs})
+    rederive_failed: Dict[str, List[str]] = {}
+    for ev in events:
+        if ev.get("type") != "program":
+            continue
+        total += 1
+        if ev.get("plan_cache"):
+            live_hits += 1
+        try:
+            prog = _RecordedProgram(ev)
+            form = _canon.try_canonicalize(prog)
+        except Exception:
+            unreadable += 1
+            continue
+        # hits record their chash; miss events fall back to the offline
+        # recomputation (faithful when statics survive repr-truncation)
+        chash = ev.get("chash") or (form.chash if form is not None
+                                    else None)
+        if chash is None or chash not in certs:
+            continue
+        covered += 1
+        if seen[chash]:
+            would_hits += 1
+        seen[chash] += 1
+        if chash not in rederive_failed:
+            bad = _plancert.rederive_check(
+                certs[chash], prog, tuple(ev.get("donate", ())))
+            rederive_failed[chash] = bad
+
+    stale_causes: Counter = Counter()
+    stale_events = forged = 0
+    for ev in events:
+        if ev.get("type") != "plan_stale":
+            continue
+        stale_events += 1
+        if ev.get("forged"):
+            forged += 1
+        for c in ev.get("causes", ()):
+            stale_causes[str(c)] += 1
+
+    broken = {ch: bad for ch, bad in rederive_failed.items() if bad}
+    rate = would_hits / total if total else 0.0
+
+    print("== plan audit ==", file=out)
+    print(
+        f"programs: {total}  certificates: {len(certs)}  "
+        f"covered: {covered}  live hits: {live_hits}  "
+        f"would-be hits: {would_hits}  would-be hit rate: {rate:.1%}"
+        + (f"  unreadable: {unreadable}" if unreadable else ""),
+        file=out,
+    )
+    if stale_events:
+        causes = ", ".join(f"{c} x{n}"
+                           for c, n in stale_causes.most_common())
+        print(
+            f"stale signatures: {stale_events} "
+            f"(forged by plan:stale: {forged})  causes: {causes or '-'}",
+            file=out,
+        )
+    for ch, cert in sorted(certs.items(),
+                           key=lambda kv: -seen[kv[0]])[:top]:
+        bad = broken.get(ch)
+        verdict = (f"PROOF BROKEN ({', '.join(bad)})" if bad
+                   else "proof re-derives")
+        print(
+            f"  {ch:<18s} x{seen[ch]:<5d} {verdict:<34s} "
+            f"sig: {','.join(cert.sig_fields)}  e.g. {cert.label}",
+            file=out,
+        )
+    if not certs:
+        print(
+            "no plan_cert events in this trace — capture with "
+            "RAMBA_PLANCERT=1 RAMBA_TRACE=<path>",
+            file=out,
+        )
+    return {
+        "programs": total,
+        "certificates": len(certs),
+        "covered": covered,
+        "live_hits": live_hits,
+        "would_hits": would_hits,
+        "would_hit_rate": round(rate, 4),
+        "stale_events": stale_events,
+        "forged_stale": forged,
+        "stale_causes": dict(stale_causes),
+        "proof_broken": {ch: list(bad) for ch, bad in broken.items()},
+    }
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="ramba-lint",
@@ -276,6 +399,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--memo-audit", action="store_true",
                     help="report recurring canonical subgraphs and the "
                          "would-be RAMBA_MEMO hit rate")
+    ap.add_argument("--plan-audit", action="store_true",
+                    help="replay program events against recorded plan "
+                         "certificates: would-be RAMBA_PLANCERT hit "
+                         "rate, stale-signature causes, proofs that no "
+                         "longer re-derive")
     args = ap.parse_args(argv)
 
     files: List[str] = []
@@ -296,6 +424,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             else:
                 print(f"== ramba-lint {path} ==")
                 memo_audit(events)
+            continue
+        if args.plan_audit:
+            if args.json:
+                audit = plan_audit(events, file=open(os.devnull, "w"))
+                print(json.dumps({"trace": path, **audit}))
+            else:
+                print(f"== ramba-lint {path} ==")
+                plan_audit(events)
             continue
         if args.json:
             offline = lint_events(events)

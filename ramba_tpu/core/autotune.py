@@ -62,6 +62,23 @@ _forced: Optional[str] = None
 _k = 3
 _cache_path: Optional[str] = None
 _table_loaded = False
+# monotone counter bumped on every decision-table mutation (latch, table
+# load, fallback, reset/reconfigure).  The plan-certificate validity
+# analysis (analyze/plancert.py) folds this into its invalidation
+# signature: a cached prepare verdict is only as fresh as the autotune
+# table it read.
+_generation = 0
+
+
+def generation() -> int:
+    """Decision-table generation: increments whenever any fingerprint's
+    backend decision could have changed."""
+    return _generation
+
+
+def _bump_generation_locked() -> None:
+    global _generation
+    _generation += 1
 
 
 def reconfigure(*, mode: Optional[str] = None,
@@ -94,6 +111,7 @@ def reconfigure(*, mode: Optional[str] = None,
         _prewarmed.clear()
         _failed.clear()
         _table_loaded = False
+        _bump_generation_locked()
 
 
 def reset() -> None:
@@ -103,6 +121,7 @@ def reset() -> None:
         _prewarmed.clear()
         _failed.clear()
         _table_loaded = False
+        _bump_generation_locked()
 
 
 def mode() -> str:
@@ -140,6 +159,7 @@ def _load_table_locked() -> None:
             n += 1
     if n:
         _registry.inc("autotune.table_loaded_decisions", n)
+        _bump_generation_locked()
 
 
 def _persist_table_locked() -> None:
@@ -232,6 +252,7 @@ def select(fp: str, program, leaf_vals) -> tuple:
                 (p50[XLA] or float("inf")) else XLA
             winner = _agree_winner(winner)
             _decisions[fp] = {"backend": winner, "via": "autotune"}
+            _bump_generation_locked()
             _registry.inc("autotune.latched")
             _registry.gauge("autotune.decisions", float(len(_decisions)))
             _persist_table_locked()
@@ -248,6 +269,7 @@ def note_failure(fp: str, backend: str, err) -> None:
     with _lock:
         _failed.add(fp)
         _decisions[fp] = {"backend": XLA, "via": "fallback"}
+        _bump_generation_locked()
         _persist_table_locked()
     _ledger.record_backend_fallback(fp, backend, str(err))
 

@@ -56,6 +56,7 @@ from ramba_tpu import common
 from ramba_tpu.compile import classes as _classes
 from ramba_tpu.compile import persist as _persist
 from ramba_tpu.core import memo as _memo
+from ramba_tpu.core import plancache as _plancache
 from ramba_tpu.core.expr import Const, Expr, Node, Scalar, OPS
 from ramba_tpu.observe import attrib as _attrib
 from ramba_tpu.observe import events as _events
@@ -497,7 +498,8 @@ class _Program:
     cache without pinning HBM.
     """
 
-    __slots__ = ("instrs", "n_leaves", "leaf_kinds", "out_slots", "key")
+    __slots__ = ("instrs", "n_leaves", "leaf_kinds", "out_slots", "key",
+                 "key_hash")
 
     def __init__(self, instrs, n_leaves, leaf_kinds, out_slots):
         self.instrs = instrs
@@ -505,6 +507,14 @@ class _Program:
         self.leaf_kinds = leaf_kinds
         self.out_slots = tuple(out_slots)
         self.key = (tuple(instrs), n_leaves, leaf_kinds, self.out_slots)
+        # Hashed at linearize time (the key is part of the capture
+        # product) so prepare-side caches keyed on the program pay an
+        # O(1) cached hash instead of re-walking the instrs tuple; -1
+        # marks an unhashable key (static carrying a list/dict).
+        try:
+            self.key_hash = hash(self.key)
+        except TypeError:
+            self.key_hash = -1
 
 
 def _linearize(roots: Sequence[Expr]):
@@ -1398,7 +1408,8 @@ class _FlushWork:
                  "leaves", "vexprs", "leaf_vals", "donate_key", "span",
                  "label", "fingerprint", "skip_fused", "pins", "flight",
                  "t_flush", "detached", "enqueued_at", "memo_plan",
-                 "memo_hit", "deadline", "is_abandoned", "class_plan")
+                 "memo_hit", "deadline", "is_abandoned", "class_plan",
+                 "plan_cert", "plan_cache")
 
     def __init__(self, stream, roots, extra_n):
         self.stream = stream
@@ -1430,6 +1441,11 @@ class _FlushWork:
         self.is_abandoned = None
         # shape-bucket compile class (compile/classes.py); None = exact
         self.class_plan = None
+        # plan-certificate cache (core/plancache.py): the certificate
+        # this flush ran under (redeemed or newly minted), and the hit
+        # tier ("hit" | "shared") — None on the miss/disabled path
+        self.plan_cert = None
+        self.plan_cache = None
 
 
 def _gather_leaf_vals(leaves):
@@ -1607,89 +1623,194 @@ def _flush_prepare(stream: FlushStream, roots: list,
         span["donated"] = len(donate_key)
         span["leaf_bytes"] = leaf_bytes
         span["mem_live_bytes"] = _memory.ledger.live_bytes
-        # Compile-class planning (RAMBA_COMPILE_CLASSES): bucket the
-        # leading dim so shape-varying traffic shares executables.  The
-        # decision is a pure function of (program, shapes, policy), so
-        # SPMD ranks agree by construction.  The compile:bucket fault
-        # site forges a plan that skips the op-safety proof — the
-        # seeded violation the compile-class verify rule exists to
-        # catch.
-        class_plan = None
-        if _classes.enabled():
-            try:
-                class_plan = _classes.plan_for(program, leaf_vals)
-            except Exception:
-                class_plan = None
-        try:
-            _faults.check("compile:bucket", label=label)
-        except _faults.InjectedFault:
-            forged = _classes.forced_plan(program, leaf_vals)
-            if forged is not None:
-                class_plan = forged
-        work.class_plan = class_plan
-        if class_plan is not None:
-            span["compile_class"] = list(class_plan.token)
-            span["pad_waste_bytes"] = class_plan.pad_waste_bytes
-        # The fingerprint folds in the class token: each bucket is its
-        # own executable, its own ledger row, its own persist entry.
-        work.fingerprint = _ledger.fingerprint(_cache_key(
-            program, donate_key,
-            class_plan.token if class_plan is not None else None))
-        if _classes.enabled():
-            _classes.note_decision(work.fingerprint, class_plan)
-        if class_plan is not None:
-            _ledger.record_class(work.fingerprint, class_plan.token,
-                                 class_plan.pad_waste_bytes, label=label)
-        if _events.trace_enabled():
-            pev = _program_event(
-                program, leaves, donate_key, label,
-                fingerprint=work.fingerprint,
-                compile_class=(class_plan.token
-                               if class_plan is not None else None))
-            if "trace_id" in span:
-                pev.setdefault("trace_id", span["trace_id"])
-                pev.setdefault("parent_span", span["span_id"])
-            _events.emit(pev)
         _profile.ensure_started()
         _telemetry.ensure_started()
         _fleet.ensure_started()
-        # In-flight leaves are never spill candidates: admission-triggered
-        # (or oom-triggered) eviction during THIS flush must not pull a
-        # buffer the program is about to read.
+        # In-flight leaves are never spill candidates: admission-
+        # triggered (or oom-triggered) eviction during THIS flush must
+        # not pull a buffer the program is about to read.
         work.pins = _memory.ledger.pin_values(leaf_vals)
-        # Result-memoization certification (RAMBA_MEMO; None when off or
-        # the program is provably uncacheable).  The plan is built before
-        # the verifier runs so the memo-safety rule audits it.
-        try:
-            work.memo_plan = _memo.plan_for(program, donate_key, leaves,
-                                            leaf_vals)
-        except Exception:
+        # Everything above is graph capture and leaf plumbing — the
+        # per-flush cost no cache can remove, paid identically whether
+        # or not a certificate redeems.  Everything below is the
+        # analysis pipeline, which a plan certificate skips; the stage
+        # ledger splits the two ("trace" vs "prepare") so the waterfall
+        # shows exactly what the fast path saves.
+        t_analysis = time.perf_counter()
+        # Plan-certificate fast path (RAMBA_PLANCERT; analyze/plancert.py
+        # + core/plancache.py): a repeat flush whose certificate's
+        # invalidation signature still validates skips the entire
+        # analysis pipeline below — class proof, fingerprint derivation,
+        # memo certification, and the verifier — behind one
+        # version-vector comparison.  A plan:stale-forged "hit" is held
+        # aside instead of redeemed: strict mode rejects it below with
+        # the same quarantine discipline as a verifier error, warn mode
+        # silently re-analyzes.
+        plan_hit = None
+        stale_hit = None
+        if _plancache.enabled():
+            try:
+                hit = _plancache.lookup(program, leaf_vals, donate_key,
+                                        label)
+            except Exception:
+                hit = None
+            if hit is not None and hit.forged:
+                if _plancache.strict():
+                    stale_hit = hit
+            elif hit is not None:
+                plan_hit = hit
+        if plan_hit is not None:
+            # Redeem: every verdict below is adopted from the certificate.
+            cert = plan_hit.cert
+            class_plan = _plancache.class_plan_from(cert)
+            work.class_plan = class_plan
+            if class_plan is not None:
+                span["compile_class"] = list(class_plan.token)
+                span["pad_waste_bytes"] = class_plan.pad_waste_bytes
+            work.fingerprint = cert.fingerprint or _ledger.fingerprint(
+                _cache_key(program, donate_key,
+                           class_plan.token
+                           if class_plan is not None else None))
+            if _classes.enabled():
+                _classes.note_decision(work.fingerprint, class_plan)
+            if class_plan is not None:
+                _ledger.record_class(work.fingerprint, class_plan.token,
+                                     class_plan.pad_waste_bytes,
+                                     label=label)
+            if _events.trace_enabled():
+                pev = _program_event(
+                    program, leaves, donate_key, label,
+                    fingerprint=work.fingerprint,
+                    compile_class=(class_plan.token
+                                   if class_plan is not None else None))
+                pev["plan_cache"] = plan_hit.tier
+                if cert.chash is not None:
+                    pev["chash"] = cert.chash
+                if "trace_id" in span:
+                    pev.setdefault("trace_id", span["trace_id"])
+                    pev.setdefault("parent_span", span["span_id"])
+                _events.emit(pev)
+            # The memo plan is rebuilt, not re-certified: only the input
+            # version tokens and shared content key are live state.
             work.memo_plan = None
+            if cert.memo_ok:
+                try:
+                    work.memo_plan = _memo.plan_from_cert(
+                        cert.chash, cert.canon_form, cert.leaf_order,
+                        cert.effects, leaves, leaf_vals)
+                except Exception:
+                    work.memo_plan = None
+            work.plan_cert = cert
+            work.plan_cache = plan_hit.tier
+            span["plan_cache"] = plan_hit.tier
+            if cert.chash is not None:
+                span["chash"] = cert.chash
+            if cert.finding_counts:
+                # the certified verdict's findings, re-stamped so the
+                # span is indistinguishable from a fresh analysis
+                span["findings"] = dict(cert.finding_counts)
+        elif stale_hit is None:
+            # Compile-class planning (RAMBA_COMPILE_CLASSES): bucket the
+            # leading dim so shape-varying traffic shares executables.
+            # The decision is a pure function of (program, shapes,
+            # policy), so SPMD ranks agree by construction.  The
+            # compile:bucket fault site forges a plan that skips the
+            # op-safety proof — the seeded violation the compile-class
+            # verify rule exists to catch.
+            class_plan = None
+            if _classes.enabled():
+                try:
+                    class_plan = _classes.plan_for(program, leaf_vals)
+                except Exception:
+                    class_plan = None
+            try:
+                _faults.check("compile:bucket", label=label)
+            except _faults.InjectedFault:
+                forged = _classes.forced_plan(program, leaf_vals)
+                if forged is not None:
+                    class_plan = forged
+            work.class_plan = class_plan
+            if class_plan is not None:
+                span["compile_class"] = list(class_plan.token)
+                span["pad_waste_bytes"] = class_plan.pad_waste_bytes
+            # The fingerprint folds in the class token: each bucket is
+            # its own executable, its own ledger row, its own persist
+            # entry.
+            work.fingerprint = _ledger.fingerprint(_cache_key(
+                program, donate_key,
+                class_plan.token if class_plan is not None else None))
+            if _classes.enabled():
+                _classes.note_decision(work.fingerprint, class_plan)
+            if class_plan is not None:
+                _ledger.record_class(work.fingerprint, class_plan.token,
+                                     class_plan.pad_waste_bytes,
+                                     label=label)
+            if _events.trace_enabled():
+                pev = _program_event(
+                    program, leaves, donate_key, label,
+                    fingerprint=work.fingerprint,
+                    compile_class=(class_plan.token
+                                   if class_plan is not None else None))
+                if "trace_id" in span:
+                    pev.setdefault("trace_id", span["trace_id"])
+                    pev.setdefault("parent_span", span["span_id"])
+                _events.emit(pev)
+            # Result-memoization certification (RAMBA_MEMO; None when
+            # off or the program is provably uncacheable).  The plan is
+            # built before the verifier runs so the memo-safety rule
+            # audits it.
+            try:
+                work.memo_plan = _memo.plan_for(program, donate_key,
+                                                leaves, leaf_vals)
+            except Exception:
+                work.memo_plan = None
     except Exception as e:
         if detached:
             _quarantine(work, e)
         _release(work)
         raise
-    t_verify = time.perf_counter()
-    try:
-        work.skip_fused = _verify_if_enabled(
-            program, leaves, vexprs, donate_key, span, label,
-            memo_plan=work.memo_plan, class_plan=work.class_plan,
-        )
-    except Exception as e:
-        _quarantine(work, e)
+    if stale_hit is not None:
+        # strict mode: a certificate that fails signature validation is
+        # rejected exactly like a verifier error — quarantine + raise
+        # before anything compiles.
+        from ramba_tpu.analyze.findings import ProgramVerificationError
+
+        err = ProgramVerificationError(
+            _plancache.stale_findings(stale_hit, label))
+        _quarantine(work, err)
         _release(work)
-        raise
-    if os.environ.get("RAMBA_VERIFY"):  # keep the stage ledger sparse
-        _attrib.add_stage(span, "verify", time.perf_counter() - t_verify)
-    if work.skip_fused:
-        # a verifier-distrusted flush must not populate (or consult) the
-        # result cache: whatever routed it down the ladder may be the
-        # very defect the memo-safety rule flagged.  The class plan is
-        # dropped for the same reason — the ladder's fallback rungs run
-        # exact shapes, so a flagged bucket claim never touches data.
-        work.memo_plan = None
-        work.class_plan = None
+        raise err
+    if plan_hit is None:
+        t_verify = time.perf_counter()
+        try:
+            work.skip_fused = _verify_if_enabled(
+                program, leaves, vexprs, donate_key, span, label,
+                memo_plan=work.memo_plan, class_plan=work.class_plan,
+            )
+        except Exception as e:
+            _quarantine(work, e)
+            _release(work)
+            raise
+        if os.environ.get("RAMBA_VERIFY"):  # keep the stage ledger sparse
+            _attrib.add_stage(span, "verify",
+                              time.perf_counter() - t_verify)
+        if work.skip_fused:
+            # a verifier-distrusted flush must not populate (or consult)
+            # the result cache: whatever routed it down the ladder may be
+            # the very defect the memo-safety rule flagged.  The class
+            # plan is dropped for the same reason — the ladder's fallback
+            # rungs run exact shapes, so a flagged bucket claim never
+            # touches data.  It must not certify either, for the same
+            # reason.
+            work.memo_plan = None
+            work.class_plan = None
+        elif _plancache.enabled():
+            # Miss path completed a full, verifier-clean analysis:
+            # snapshot it as a certificate for the next repeat.
+            try:
+                work.plan_cert = _plancache.certify(work)
+            except Exception:
+                work.plan_cert = None
     if work.memo_plan is not None:
         try:
             work.memo_hit = _memo.lookup(work.memo_plan)
@@ -1704,12 +1825,15 @@ def _flush_prepare(stream: FlushStream, roots: list,
         work.deadline = _overload.mint_deadline(stream.deadline_ms)
         if work.deadline is not None:
             span["deadline_ms"] = work.deadline.budget_ms
-    # Everything on the caller thread so far (linearize, fuse, leaf
-    # gather, donation census, memo/class planning) minus the verifier,
-    # which has its own stage.
+    # Caller-thread attribution: "trace" is linearize + fuse + leaf
+    # gather + donation census (unavoidable per flush); "prepare" is the
+    # analysis pipeline from there on — class/memo/plan certification or
+    # the certificate redemption — minus the verifier, which has its own
+    # stage.
+    _attrib.add_stage(span, "trace", t_analysis - work.t_flush)
     _attrib.add_stage(
         span, "prepare",
-        (time.perf_counter() - work.t_flush)
+        (time.perf_counter() - t_analysis)
         - span["stages"].get("verify", 0.0))
     return work
 

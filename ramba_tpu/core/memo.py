@@ -374,6 +374,64 @@ def plan_for(program: Any, donate_key: Tuple[int, ...], leaves: List[Any],
     )
 
 
+def plan_from_cert(chash: Optional[str], form: Optional[str],
+                   leaf_order: Tuple[int, ...],
+                   effects: Optional[_effects.EffectReport],
+                   leaves: List[Any],
+                   leaf_vals: List[Any]) -> Optional[MemoPlan]:
+    """Rebuild a certified :class:`MemoPlan` from a plan certificate
+    (``analyze/plancert.py``) without re-running effect classification
+    or canonicalization — the certificate already vouches for both, and
+    its invalidation signature proves the verdicts still hold.  Only the
+    live state binds per flush: the per-input version tokens and the
+    shared-tier content key.  Returns None when memoization is disarmed,
+    the certificate carried no canonical hash, or an input cannot be
+    version-tracked (same bail-outs as :func:`plan_for`)."""
+    if not enabled() or chash is None:
+        return None
+    from ramba_tpu.core.expr import Scalar
+
+    tokens: List[Any] = []
+    parts: List[Any] = []  # content-hashable form, canonical leaf order
+    for slot in leaf_order:
+        if slot >= len(leaves):
+            return None
+        leaf = leaves[slot]
+        if isinstance(leaf, Scalar):
+            try:
+                tokens.append(("s", type(leaf.value).__name__,
+                               leaf.value))
+                hash(tokens[-1])
+            except TypeError:
+                return None
+            parts.append(tokens[-1])
+        else:
+            tok = value_token(leaf_vals[slot])
+            if tok is None:
+                return None
+            tokens.append(tok)
+            parts.append(leaf_vals[slot])
+    from ramba_tpu.core import fuser as _fuser
+
+    fingerprint = _fuser._semantic_fingerprint()
+    key = (chash, tuple(tokens), fingerprint)
+    shared_key = None
+    tier = _shared_tier()
+    if tier is not None:
+        shared_key = tier.content_key(chash, parts, fingerprint)
+    return MemoPlan(
+        memoizable=True,
+        certified=True,
+        reason="",
+        chash=chash,
+        form=form,
+        leaf_order=tuple(leaf_order),
+        key=key,
+        effects=effects,
+        shared_key=shared_key,
+    )
+
+
 def lookup(plan: Optional[MemoPlan]) -> Optional[List[Any]]:
     """Consult the result cache for a certified plan.  A hit returns the
     cached output values (restored from host spill when needed)."""

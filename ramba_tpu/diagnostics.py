@@ -217,6 +217,15 @@ def memo_report() -> dict:
     return _memo.cache.snapshot()
 
 
+def plancache_report() -> dict:
+    """Plan-certificate cache snapshot (core/plancache.py): certified
+    entries, hit/miss/stale/forged counters, per-field stale causes and
+    the derived fast-path hit rate."""
+    from ramba_tpu.core import plancache as _plancache
+
+    return _plancache.snapshot()
+
+
 def snapshot() -> dict:
     """Everything, JSON-serializable: registry stores + the event ring.
 
@@ -248,6 +257,9 @@ def snapshot() -> dict:
     memo = memo_report()
     if memo["enabled"] or memo["inserts"] or memo["hits"]:
         snap["memo"] = memo
+    plan = plancache_report()
+    if plan["enabled"] or plan.get("lookups") or plan.get("stores"):
+        snap["plancache"] = plan
     return snap
 
 
@@ -385,6 +397,26 @@ def report(file=None) -> None:
             f" rejects={memo['insert_rejects']}",
             file=file,
         )
+    plan = plancache_report()
+    if plan["enabled"] or plan.get("lookups") or plan.get("stores"):
+        print("-- plan cache --", file=file)
+        print(
+            f"  entries={plan['entries']}"
+            f" hits={plan.get('hits', 0)}"
+            f"+{plan.get('shared_hits', 0)}shared"
+            f" misses={plan.get('misses', 0)}"
+            f" hit_rate={plan['hit_rate']:.1%}"
+            f" stores={plan.get('stores', 0)}"
+            f" stale={plan.get('stale', 0)}"
+            f" forged={plan.get('forged_stale', 0)}"
+            f" adopted={plan.get('adopted', 0)}"
+            f" published={plan.get('publishes', 0)}",
+            file=file,
+        )
+        if plan["stale_causes"]:
+            causes = " ".join(f"{c}={n}" for c, n in
+                              sorted(plan["stale_causes"].items()))
+            print(f"  stale causes: {causes}", file=file)
     serving = serving_report()
     if serving:
         print("-- serving (per tenant) --", file=file)

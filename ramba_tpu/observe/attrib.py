@@ -50,7 +50,11 @@ from ramba_tpu.observe import registry as _registry
 # Canonical stage order: a span's stages, iterated in this order, read as
 # the flush's waterfall.  Keep in sync with the glossary in docs/index.md.
 STAGES = (
-    "prepare",         # caller thread: linearize + fuse + cache lookup
+    "trace",           # caller thread: linearize + fuse + leaf plumbing
+                       # (graph capture — unavoidable per flush)
+    "prepare",         # caller thread: the analysis pipeline — class
+                       # proof, fingerprint, memo certification, plan
+                       # cache (skippable via a plan certificate)
     "verify",          # RAMBA_VERIFY eager shadow evaluation
     "queue_wait",      # async pipeline: submit -> group pop
     "coalesce",        # async pipeline: group pop -> this ticket's dispatch

@@ -20,7 +20,7 @@ else
     trap 'rm -rf "$td"' EXIT
     echo "== lint.sh: capturing smoke trace =="
     JAX_PLATFORMS=cpu RAMBA_TRACE="$td/smoke.jsonl" RAMBA_VERIFY=warn \
-        RAMBA_MEMO=1 python - <<'EOF'
+        RAMBA_MEMO=1 RAMBA_PLANCERT=1 python - <<'EOF'
 import numpy as np
 import ramba_tpu as rt
 
@@ -39,6 +39,9 @@ JAX_PLATFORMS=cpu python -m ramba_tpu.analyze --strict "${traces[@]}" || rc=1
 echo "== lint.sh: ramba-lint --memo-audit =="
 JAX_PLATFORMS=cpu python -m ramba_tpu.analyze --memo-audit "${traces[@]}" || rc=1
 
+echo "== lint.sh: ramba-lint --plan-audit =="
+JAX_PLATFORMS=cpu python -m ramba_tpu.analyze --plan-audit "${traces[@]}" || rc=1
+
 if command -v ruff >/dev/null 2>&1; then
     echo "== lint.sh: ruff =="
     ruff check ramba_tpu tests scripts bench.py || rc=1
@@ -49,7 +52,7 @@ fi
 if command -v mypy >/dev/null 2>&1; then
     echo "== lint.sh: mypy (typed-surface gate) =="
     mypy ramba_tpu/analyze ramba_tpu/core/expr.py ramba_tpu/core/memo.py \
-        || rc=1
+        ramba_tpu/core/plancache.py || rc=1
 else
     echo "== lint.sh: mypy not installed, skipping =="
 fi

@@ -54,6 +54,9 @@ _METRIC_DIRECTION = {
     "shed_fail_fast_ms": "lower",       # classified-rejection fast path
     "memo_hit_rate": "higher",          # result-cache dedup (RAMBA_MEMO)
     "serving_dup_execs": "lower",       # duplicates that escaped batch CSE
+    "plan_hit_rate": "higher",          # certificate redemptions (PLANCERT)
+    "fast_path_floor_us": "lower",      # prepare+verify p50 on plan hits
+    "plan_fast_path_speedup": "higher",  # miss/hit prepare+verify p50 ratio
     "observe_events_per_s": "higher",
     "observe_flush_overhead_pct": "lower",
     "observe_scrape_ms": "lower",

@@ -52,7 +52,8 @@ from collections import defaultdict
 
 # canonical stage order (mirrors ramba_tpu.observe.attrib.STAGES —
 # duplicated so this script stays stdlib-only / copyable off-host)
-STAGE_ORDER = ("prepare", "verify", "queue_wait", "coalesce", "compile",
+STAGE_ORDER = ("trace", "prepare", "verify", "queue_wait", "coalesce",
+               "compile",
                "admit", "dispatch", "device_execute", "write_back")
 
 
@@ -212,6 +213,33 @@ def report(path: str, events: list, top: int = 10, file=None) -> None:
             line += (f"  bucketed flushes: {len(bucketed)}"
                      f" classes: {len(classes)}"
                      f" pad waste: {_fmt_bytes(waste)}")
+        print(line, file=file)
+    # plan-certificate cache (PR-18): hits skip the prepare-side
+    # analysis pipeline; stale events name the invalidation causes
+    plan_hits = sum(1 for f in flushes if f.get("plan_cache"))
+    plan_stale = [e for e in events if e.get("type") == "plan_stale"]
+    if plan_hits or plan_stale:
+        shared = sum(1 for f in flushes
+                     if f.get("plan_cache") == "shared")
+        line = (f"plan cache: {plan_hits}/{len(flushes)} flushes on the "
+                f"fast path ({100.0 * plan_hits / len(flushes):.0f}%)")
+        if shared:
+            line += f"  adopted from shared tier: {shared}"
+        if plan_stale:
+            causes = defaultdict(int)
+            forged = 0
+            for e in plan_stale:
+                if e.get("forged"):
+                    forged += 1
+                for c in e.get("causes", ()):
+                    causes[str(c)] += 1
+            cs = "  ".join(f"{c}={n}"
+                           for c, n in sorted(causes.items()))
+            line += f"  stale: {len(plan_stale)}"
+            if forged:
+                line += f" (forged: {forged})"
+            if cs:
+                line += f" causes: {cs}"
         print(line, file=file)
     cse = [e for e in events if e.get("type") == "cse_merge"]
     if memo_hits or cse:
@@ -587,6 +615,14 @@ def _merge_line(e: dict) -> str:
         instrs = e.get("instrs")
         n = len(instrs) if isinstance(instrs, list) else instrs
         return f"program   {e.get('label', '?')} instrs={n}"
+    if t == "plan_stale":
+        causes = ",".join(e.get("causes") or []) or "?"
+        tag = " FORGED" if e.get("forged") else ""
+        return (f"plan_stale {e.get('label', '?')}"
+                f" causes={causes}{tag}")
+    if t == "plan_divergence":
+        return (f"plan_diverge proposed={e.get('proposed', '?')}"
+                f" agreed={e.get('agreed', '?')} (cache cleared)")
     if t == "memory":
         return (f"memory    {e.get('action', '?')}"
                 f" {_fmt_bytes(e.get('bytes', e.get('over_bytes', 0)) or 0)}")
@@ -756,7 +792,7 @@ def merge_report(path: str, per_rank: dict, file=None, cap: int = 80) -> None:
                  "flush_error", "health", "serve_coalesce", "stall",
                  "lifecycle", "coherence", "reshard", "shed", "breaker",
                  "hedge", "brownout", "redirect", "heal", "migrate",
-                 "replica"):
+                 "replica", "plan_stale", "plan_divergence"):
             return True
         if t == "memory":
             return not (e.get("action") == "admit" and e.get("ok"))
@@ -881,6 +917,27 @@ def attrib_report(path: str, events: list, top: int = 10,
         print(f"  {label} x{agg['n']} wall={agg['wall']:.4f}s", file=file)
         print("    " + _waterfall(agg["stages"], agg["wall"],
                                   agg["unattributed"]), file=file)
+    # plan-cache fast path (PR-18): a hit skips the prepare-side
+    # analysis pipeline, so its prepare+verify collapses to the
+    # version-vector check — quantify the drop against the miss path
+    def _pv(e: dict) -> float:
+        s = e["stages"]
+        return ((s.get("prepare") or 0.0) + (s.get("verify") or 0.0))
+
+    plan_hits = [e for e in flushes if e.get("plan_cache")]
+    if plan_hits:
+        plan_misses = [e for e in flushes if not e.get("plan_cache")]
+        hs = sorted(_pv(e) for e in plan_hits)
+        h50 = hs[len(hs) // 2]
+        line = (f"plan-cache fast path: {len(plan_hits)} hit(s)  "
+                f"prepare+verify p50 {h50 * 1e6:.0f}us")
+        if plan_misses:
+            ms = sorted(_pv(e) for e in plan_misses)
+            m50 = ms[len(ms) // 2]
+            line += f" vs {m50 * 1e6:.0f}us on the miss path"
+            if h50 > 0:
+                line += f" ({m50 / h50:.1f}x)"
+        print(line, file=file)
     recent = flushes[-8:]
     print(f"recent flushes (last {len(recent)}):", file=file)
     for e in recent:
@@ -888,7 +945,8 @@ def attrib_report(path: str, events: list, top: int = 10,
         u = e.get("unattributed_s")
         u = u if isinstance(u, (int, float)) else 0.0
         rung = e.get("degraded", "fused")
-        print(f"  {e.get('label', '?')} [{rung}] wall={wall:.4f}s  "
+        plan = f" plan={e['plan_cache']}" if e.get("plan_cache") else ""
+        print(f"  {e.get('label', '?')} [{rung}]{plan} wall={wall:.4f}s  "
               + _waterfall(e["stages"], wall, u), file=file)
     gaps = sorted(per_label.items(), key=lambda kv: kv[1]["unattributed"],
                   reverse=True)

@@ -157,6 +157,17 @@ canonical hash, the identical hit/insert counts, the correct value,
 and that each per-rank trace carries memo-served flush spans
 (``cache == "memo"``).
 
+``--plancache-leg`` runs the plan-certificate acceptance leg: both
+ranks under ``RAMBA_PLANCERT=1 RAMBA_VERIFY=strict`` flush the same
+program repeatedly.  The cache key and invalidation signature are pure
+functions of rank-identical state (program structure, avals, mesh
+epoch, rule set), so hit/miss decisions MUST be lockstep — a rank
+redeeming a certificate while its peer re-analyzes would skew the
+flush sequences.  The leg runs the epoch-batched ``agree()`` round at
+a small batch size, asserts zero divergences, and the runner compares
+hit/store/stale markers across ranks and asserts each per-rank trace
+carries certificate-redeemed flush spans (``plan_cache == "hit"``).
+
 ``--warmstart-leg`` runs the compile-class / warm-start acceptance leg
 (PR 14): two phases of two ranks each, sharing per-rank ``RAMBA_CACHE``
 directories across phases.  Under ``RAMBA_COMPILE_CLASSES=pow2`` the
@@ -360,6 +371,45 @@ snap = memo.cache.snapshot()
 assert snap['hits'] >= 3, snap
 print('MEMO_LEG rank=%d chash=%s hits=%d inserts=%d' % (
     rank, c1.chash, snap['hits'], snap['inserts']))
+"""
+
+
+# SPMD workload for the plancache leg: each rank forms the process
+# group, flushes the same fused chain five times under strict verify
+# with the plan cache armed, then drains the batched coherence round.
+# The cache decision sequence (1 store + 4 hits) is a deterministic
+# function of rank-identical inputs, so the printed counters must match
+# across ranks, and the agree() exchange must see equal batch counts
+# (zero divergences).  argv: <rank> <coordinator>.
+_PLANCACHE_WORKLOAD = """
+import sys
+import numpy as np
+rank, coord = int(sys.argv[1]), sys.argv[2]
+from ramba_tpu.parallel import distributed
+distributed.initialize(coordinator_address=coord, num_processes=2,
+                       process_id=rank)
+import jax
+assert jax.process_count() == 2, jax.process_count()
+import ramba_tpu as rt
+from ramba_tpu.core import fuser, plancache
+assert plancache.enabled(), 'RAMBA_PLANCERT not armed'
+a = rt.arange(4096) / 100.0
+b = rt.arange(4096) * 0.5 + 1.0
+rt.sync()
+vals = [float(rt.sum((a + b) * 2.0)) for _ in range(5)]
+assert max(vals) == min(vals), vals
+an = np.arange(4096)
+exp = float(np.sum((an / 100.0 + (an * 0.5 + 1.0)) * 2.0))
+assert abs(vals[0] - exp) <= 1e-4 * abs(exp), (vals[0], exp)
+plancache.flush_agree()
+snap = plancache.snapshot()
+assert snap.get('hits', 0) >= 3, snap
+assert not snap.get('divergences'), snap
+assert not snap.get('stale'), snap
+print('PLANCACHE_LEG rank=%d hits=%d stores=%d stale=%d agree=%d '
+      'div=%d' % (rank, snap.get('hits', 0), snap.get('stores', 0),
+                  snap.get('stale', 0), snap.get('agree_rounds', 0),
+                  snap.get('divergences', 0)))
 """
 
 
@@ -2137,6 +2187,106 @@ def run_memo_leg() -> int:
     return 0 if ok else 1
 
 
+def run_plancache_leg() -> int:
+    """Two ranks under RAMBA_PLANCERT=1 + strict verify; the cache
+    key/signature are pure functions of rank-identical state, so both
+    ranks must store and redeem certificates in LOCKSTEP (a hit skips
+    the analysis pipeline — rank-skewed decisions would desync the
+    flush sequences), with zero batched-agree divergences."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    basetemp = tempfile.mkdtemp(prefix="ramba_2proc_plancache_")
+    trace_base = os.path.join(basetemp, "trace.jsonl")
+    budget = float(os.environ.get("RAMBA_TEST_PROCS_TIMEOUT", "600"))
+
+    procs, logs = [], []
+    for rank in range(2):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        for k in ("RAMBA_TEST_PROCS", "RAMBA_TEST_PROC_ID",
+                  "RAMBA_TEST_COORD", "RAMBA_TEST_SHARED_TMP",
+                  "RAMBA_PROFILE_DIR", "RAMBA_FAULTS", "RAMBA_HBM_BUDGET",
+                  "RAMBA_ARTIFACTS", "RAMBA_VERIFY_RULES"):
+            env.pop(k, None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["RAMBA_PLANCERT"] = "1"
+        env["RAMBA_PLANCERT_AGREE"] = "2"
+        env["RAMBA_VERIFY"] = "strict"
+        env["RAMBA_TRACE"] = trace_base
+        log = open(os.path.join(basetemp, f"rank{rank}.log"), "w")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _PLANCACHE_WORKLOAD, str(rank),
+             f"localhost:{port}"],
+            env=env, stdout=log, stderr=subprocess.STDOUT, cwd=REPO,
+        ))
+
+    deadline = time.time() + budget
+    rcs = [None, None]
+    try:
+        for i, p in enumerate(procs):
+            left = max(5.0, deadline - time.time())
+            try:
+                rcs[i] = p.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                rcs[i] = -9
+    finally:
+        for log in logs:
+            log.close()
+
+    ok = all(rc == 0 for rc in rcs)
+
+    # Hit/store/stale counts are a deterministic function of the flush
+    # sequence over rank-identical state: markers must be IDENTICAL.
+    markers = [None, None]
+    for rank in range(2):
+        path = os.path.join(basetemp, f"rank{rank}.log")
+        with open(path) as f:
+            tail = f.read().splitlines()
+        for line in tail:
+            if line.startswith(f"PLANCACHE_LEG rank={rank} "):
+                markers[rank] = line.split(" ", 2)[2]
+        if markers[rank] is None:
+            ok = False
+        print(f"--- plancache leg rank {rank} rc={rcs[rank]} ({path}) ---")
+        print("\n".join(tail[-(4 if ok else 40):]))
+    if ok and markers[0] != markers[1]:
+        print(f"plancache leg: FAIL (rank skew: r0={markers[0]} "
+              f"r1={markers[1]})")
+        ok = False
+    elif ok:
+        print(f"plancache leg: lockstep across ranks ({markers[0]})")
+
+    # Each per-rank trace must carry certificate-redeemed flush spans:
+    # the hits were real analysis skips, visible to trace_report.
+    import json
+
+    for rank in range(2):
+        path = f"{trace_base}.rank{rank}"
+        try:
+            with open(path) as f:
+                evs = [json.loads(ln) for ln in f if ln.strip()]
+            n_hit = sum(1 for e in evs if e.get("type") == "flush"
+                        and e.get("plan_cache") == "hit")
+            print(f"plancache leg rank {rank}: {len(evs)} events, "
+                  f"{n_hit} certificate-redeemed flushes")
+            if n_hit < 3:
+                print(f"plancache leg rank {rank}: FAIL "
+                      f"(plan_cache spans={n_hit})")
+                ok = False
+        except (OSError, ValueError) as e:
+            print(f"plancache leg rank {rank}: FAIL ({e})")
+            ok = False
+
+    print(f"two-process plancache leg: {'OK' if ok else 'FAIL'}")
+    if ok:
+        shutil.rmtree(basetemp, ignore_errors=True)
+    return 0 if ok else 1
+
+
 def run_warmstart_leg() -> int:
     """Cold phase + warm phase of two SPMD ranks each, sharing per-rank
     RAMBA_CACHE dirs across phases.  Both ranks must pick IDENTICAL
@@ -2913,6 +3063,8 @@ def main() -> int:
         return run_autotune_leg()
     if "--memo-leg" in sys.argv[1:]:
         return run_memo_leg()
+    if "--plancache-leg" in sys.argv[1:]:
+        return run_plancache_leg()
     if "--warmstart-leg" in sys.argv[1:]:
         return run_warmstart_leg()
     if "--overload-leg" in sys.argv[1:]:

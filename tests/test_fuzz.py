@@ -173,6 +173,69 @@ def test_random_program_verified_strict(seed, monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# Plan-certificate leg: the same random programs, run twice under
+# RAMBA_PLANCERT=1 + strict verify — the second pass redeems certificates
+# minted by the first, so every redeemed verdict is checked byte-for-byte
+# against the full-analysis answer on arbitrary program shapes.  The
+# plan:stale variants seed the module's own fault site: warn mode must
+# silently re-analyze (still matching numpy), strict must reject.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(0, 40, 4))
+def test_random_program_plan_cache_strict(seed, monkeypatch):
+    from ramba_tpu.core import plancache
+
+    monkeypatch.setenv("RAMBA_VERIFY", "strict")
+    monkeypatch.setenv("RAMBA_PLANCERT", "1")
+    plancache.reset()
+    try:
+        _check(seed)            # first pass analyzes + certifies
+        _check(seed)            # second pass redeems — same oracle
+        snap = plancache.snapshot()
+        assert snap.get("hits", 0) >= 1, snap
+        assert not snap.get("stale"), snap
+    finally:
+        plancache.reset()
+
+
+@pytest.mark.parametrize("seed", range(0, 40, 8))
+def test_random_program_plan_stale_warn_reanalyzes(seed, monkeypatch):
+    from ramba_tpu.core import plancache
+    from ramba_tpu.resilience import faults
+
+    monkeypatch.setenv("RAMBA_VERIFY", "warn")
+    monkeypatch.setenv("RAMBA_PLANCERT", "1")
+    plancache.reset()
+    try:
+        _check(seed)
+        with faults.active("plan:stale:0.5", seed=seed):
+            _check(seed)        # forged verdicts silently re-analyze
+    finally:
+        plancache.reset()
+
+
+@pytest.mark.parametrize("seed", [0, 16])
+def test_random_program_plan_stale_strict_raises(seed, monkeypatch):
+    from ramba_tpu.analyze.findings import ProgramVerificationError
+    from ramba_tpu.core import fuser, plancache
+    from ramba_tpu.resilience import faults
+
+    monkeypatch.setenv("RAMBA_VERIFY", "strict")
+    monkeypatch.setenv("RAMBA_PLANCERT", "1")
+    plancache.reset()
+    try:
+        _check(seed)
+        with faults.active("plan:stale:always", seed=seed):
+            with pytest.raises(ProgramVerificationError,
+                               match="plan-stale"):
+                _check(seed)    # first redemption is forged: rejected
+    finally:
+        fuser.flush()
+        plancache.reset()
+
+
+# ---------------------------------------------------------------------------
 # Memory-pressure leg: the same random programs must survive seeded device
 # OOM — each compiled execute has a 20% (seed-deterministic) chance of
 # RESOURCE_EXHAUSTED, so the ladder's evict → drop-rung → retry path runs
