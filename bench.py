@@ -1156,6 +1156,97 @@ def _bench_compile(rt, platform):
     return out
 
 
+def _bench_integrity(rt, platform):
+    """Data-integrity-plane section (resilience/integrity.py).  Three
+    numbers feed scripts/perf_diff.py: ``integrity_overhead_frac``
+    (digest stamp+verify wall as a fraction of the flush wall it rides
+    on — the acceptance gate is under 2%), ``audit_overhead_ms`` (mean
+    shadow-recompute cost per audited flush under RAMBA_AUDIT=1) and
+    ``fsck_scan_ms`` (offline verification wall over the freshly-seeded
+    artifact tier)."""
+    import os
+    import shutil
+    import sys
+    import tempfile
+    import time
+
+    from ramba_tpu.core import memo as _memo
+    from ramba_tpu.fleet import artifacts as _artifacts
+    from ramba_tpu.resilience import integrity as _integrity
+
+    saved = {k: os.environ.get(k)
+             for k in ("RAMBA_MEMO", "RAMBA_ARTIFACTS", "RAMBA_AUDIT",
+                       "RAMBA_INTEGRITY")}
+    art = tempfile.mkdtemp(prefix="ramba_bench_integrity_")
+    os.environ["RAMBA_MEMO"] = "1"
+    os.environ["RAMBA_ARTIFACTS"] = art
+    os.environ.pop("RAMBA_AUDIT", None)
+    os.environ.pop("RAMBA_INTEGRITY", None)
+    _memo.reset()
+    _artifacts.reset()
+    _integrity.reset()
+    out = {}
+    try:
+        n = 65_536 if platform != "cpu" else 8_192
+        base = rt.arange(n) / 7.0
+        rt.sync()
+        reps = 12
+        t0 = time.perf_counter()
+        for k in range(reps):
+            r = base * float(k + 2) + 1.0
+            r.asarray()
+            del r
+        flush_wall = time.perf_counter() - t0
+        snap = _integrity.snapshot()
+        if snap["stamped"] and flush_wall > 0:
+            out["integrity_overhead_frac"] = round(
+                snap["digest_wall_s"] / flush_wall, 5)
+            out["integrity_digest_mb_per_s"] = round(
+                snap["digest_bytes"] / max(snap["digest_wall_s"], 1e-9)
+                / 1e6, 1)
+
+        # shadow-audit cost: every certified flush re-executes eagerly
+        os.environ["RAMBA_AUDIT"] = "1"
+        _integrity.reset()
+        for k in range(6):
+            r = base * float(k + 50) - 3.0
+            r.asarray()
+            del r
+        snap = _integrity.snapshot()
+        if snap["audits"]:
+            out["audit_overhead_ms"] = round(
+                snap["audit_wall_s"] / snap["audits"] * 1e3, 3)
+            out["audit_mismatches"] = snap["audit_mismatches"]
+        os.environ.pop("RAMBA_AUDIT", None)
+
+        # offline scan over the tier the loops above just seeded
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "scripts"))
+        try:
+            import ramba_fsck as _fsck
+
+            t0 = time.perf_counter()
+            r = _fsck.scan(artifacts=art)
+            out["fsck_scan_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 2)
+            out["fsck_scanned"] = r["scanned"]
+            if r["corrupt"]:
+                out["fsck_corrupt"] = r["corrupt"]
+        finally:
+            sys.path.pop(0)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        _memo.reset()
+        _artifacts.reset()
+        _integrity.reset()
+        shutil.rmtree(art, ignore_errors=True)
+    return out
+
+
 def _bench_attribution(rt, platform):
     """Attribution rollup of everything this bench ran (must be the LAST
     section): stage-seconds waterfall + unattributed residual across all
@@ -1403,6 +1494,11 @@ def main():
             out.update(_bench_attribution(rt, platform))
         except Exception:  # noqa: BLE001
             out["attribution_error"] = traceback.format_exc(limit=2)[-300:]
+
+        try:
+            out.update(_bench_integrity(rt, platform))
+        except Exception:  # noqa: BLE001
+            out["integrity_error"] = traceback.format_exc(limit=2)[-300:]
     except Exception:  # noqa: BLE001 - even import/backend failure emits JSON
         out["error"] = traceback.format_exc(limit=3)[-400:]
 

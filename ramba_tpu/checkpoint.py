@@ -41,6 +41,7 @@ from ramba_tpu.core.fuser import flush
 from ramba_tpu.core.ndarray import ndarray
 from ramba_tpu.observe import registry as _registry
 from ramba_tpu.resilience import faults as _faults
+from ramba_tpu.resilience import integrity as _integrity
 from ramba_tpu.resilience import retry as _retry
 
 
@@ -52,6 +53,152 @@ class CheckpointCorruptError(RuntimeError):
 # Deterministic tmp sibling (not mkdtemp): every SPMD rank must compute
 # the same staging path, and a crashed writer's debris is findable.
 _TMP_SUFFIX = ".ramba-tmp"
+
+# Digest sidecar published by rank 0 after the checkpoint rename: logical
+# per-leaf content digests (stamped from the values handed to Orbax, so a
+# restore verifies end to end) plus a file-level digest map of the
+# published directory (what ramba-fsck and the pre-restore scan verify
+# without initializing Orbax).  Lives OUTSIDE the Orbax dir so Orbax's
+# own directory handling never sees a foreign file.
+_DIGESTS_SUFFIX = ".digests.json"
+_DIGESTS_SCHEMA = "ckpt.digests.json"
+
+
+def digests_path(path: str) -> str:
+    return os.path.abspath(path) + _DIGESTS_SUFFIX
+
+
+def _leaf_items(vals) -> list:
+    import jax.tree_util as jtu
+
+    return [(jtu.keystr(p), v)
+            for p, v in jtu.tree_flatten_with_path(vals)[0]]
+
+
+def _write_digests(apath: str, vals) -> None:
+    """Rank-0 sidecar publish (post-rename).  Best-effort: a failed
+    digest pass removes any stale sidecar rather than leaving one that
+    contradicts the new checkpoint."""
+    import json
+    import tempfile
+
+    side = apath + _DIGESTS_SUFFIX
+    if not _integrity.enabled():
+        try:  # a stale sidecar must not contradict the new checkpoint
+            os.unlink(side)
+        except OSError:
+            pass
+        return
+    try:
+        leaves = {}
+        for keystr, v in _leaf_items(vals):
+            if not getattr(v, "is_fully_addressable", True):
+                # multi-host shard-split value: no single process holds
+                # the global bytes — skip logical digests, keep files
+                leaves = None
+                break
+            leaves[keystr] = {
+                "sha256": _integrity.array_digest(v),
+                "shape": [int(s) for s in np.shape(v)],
+                "dtype": str(np.dtype(getattr(v, "dtype", type(v)))),
+            }
+        files = {}
+        for root, _dirs, names in os.walk(apath):
+            for name in names:
+                full = os.path.join(root, name)
+                rel = os.path.relpath(full, apath)
+                files[rel] = {"sha256": _integrity.file_digest(full),
+                              "size": os.path.getsize(full)}
+        doc = {"schema": 1, "leaves": leaves, "files": files}
+        data = _integrity.wrap(json.dumps(doc, sort_keys=True).encode(),
+                               _DIGESTS_SCHEMA)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(side) or ".",
+                                   prefix=".tmp-")
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.replace(tmp, side)
+        _registry.inc("checkpoint.digests_written")
+    except Exception:  # noqa: BLE001 — the sidecar must never fail a save
+        try:
+            os.unlink(side)
+        except OSError:
+            pass
+
+
+def _load_digests(apath: str):
+    """Parse a checkpoint's digest sidecar.  ``None`` when absent (a
+    pre-plane checkpoint restores unverified); a corrupt sidecar raises —
+    an unverifiable checkpoint must not be served silently."""
+    import json
+
+    side = apath + _DIGESTS_SUFFIX
+    try:
+        with open(side, "rb") as f:
+            raw = f.read()
+    except OSError:
+        return None
+    if not _integrity.enabled():
+        return None
+    try:
+        payload = _integrity.unwrap(raw, _DIGESTS_SCHEMA,
+                                    site="checkpoint:leaf")
+        return json.loads(payload.decode())
+    except (_integrity.IntegrityError, ValueError) as e:
+        raise CheckpointCorruptError(
+            f"checkpoint digest sidecar at {side!r} is corrupt ({e})"
+        ) from e
+
+
+def _verify_files(path: str, apath: str, doc: dict) -> None:
+    """Pre-restore scan: every file the save stamped must still be
+    byte-identical.  This is what catches a clobbered/truncated *leaf*
+    file even when its bytes would still deserialize."""
+    files = doc.get("files") or {}
+    if _faults.configured("checkpoint:leaf") and files:
+        # flip seam (RAMBA_FAULTS='checkpoint:leaf:flip:...'): physically
+        # corrupt the first stamped data file, upstream of verification —
+        # the flip persists on disk, so ramba-fsck finds it offline too
+        rel = sorted(files)[0]
+        _faults.corrupt_file("checkpoint:leaf", os.path.join(apath, rel))
+    for rel, want in sorted(files.items()):
+        full = os.path.join(apath, rel)
+        try:
+            size = os.path.getsize(full)
+        except OSError as e:
+            _integrity.failure("checkpoint:leaf", "missing", detail=rel)
+            raise CheckpointCorruptError(
+                f"checkpoint at {path!r} is missing leaf file {rel!r} "
+                f"({e})") from e
+        if size != want.get("size"):
+            _integrity.failure("checkpoint:leaf", "length", detail=rel)
+            raise CheckpointCorruptError(
+                f"checkpoint at {path!r} leaf file {rel!r} is "
+                f"{size} bytes, manifest says {want.get('size')}")
+        if _integrity.file_digest(full) != want.get("sha256"):
+            _integrity.failure("checkpoint:leaf", "digest", detail=rel)
+            raise CheckpointCorruptError(
+                f"checkpoint at {path!r} leaf file {rel!r} failed digest "
+                f"verification (silent corruption)")
+
+
+def _verify_leaves(path: str, out, doc: dict) -> None:
+    """Post-restore logical check: the restored arrays' content digests
+    must match what was stamped at save time — end-to-end coverage of
+    the disk -> host -> device path, sharding-independent."""
+    leaves = doc.get("leaves")
+    if not leaves:
+        return
+    for keystr, v in _leaf_items(out):
+        want = leaves.get(keystr)
+        if want is None:
+            continue
+        if not getattr(v, "is_fully_addressable", True):
+            continue
+        if _integrity.array_digest(v) != want["sha256"]:
+            _integrity.failure("checkpoint:leaf", "digest", detail=keystr)
+            raise CheckpointCorruptError(
+                f"checkpoint at {path!r} restored leaf {keystr!r} failed "
+                f"content-digest verification (silent corruption)")
 
 
 def _barrier(tag: str) -> None:
@@ -128,6 +275,9 @@ def save(path: str, tree, *, force: bool = False) -> None:
             shutil.rmtree(apath)
         os.replace(tmp, apath)
     _barrier("ramba_ckpt_published")
+    if jax.process_index() == 0:
+        _write_digests(apath, vals)
+    _barrier("ramba_ckpt_digests")
     _registry.inc("checkpoint.saves")
 
 
@@ -144,6 +294,13 @@ def restore(path: str, target=None):
     apath = os.path.abspath(path)
     if not os.path.isdir(apath):
         raise CheckpointCorruptError(f"no checkpoint directory at {path!r}")
+
+    # Integrity pre-scan: verify the published files against the digest
+    # sidecar BEFORE Orbax touches them — a clobbered leaf file raises
+    # CheckpointCorruptError here even when its bytes still deserialize.
+    digests = _load_digests(apath)
+    if digests is not None:
+        _verify_files(path, apath, digests)
 
     def spec(x):
         if isinstance(x, ndarray):
@@ -189,6 +346,8 @@ def restore(path: str, target=None):
             f"restore target ({type(e).__name__}: {e})"
         ) from e
     _validate(path, out, tgt)
+    if digests is not None:
+        _verify_leaves(path, out, digests)
     _registry.inc("checkpoint.restores")
     return jax.tree.map(lambda v: ndarray(Const(v)), out)
 

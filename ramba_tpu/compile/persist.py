@@ -43,8 +43,13 @@ import numpy as np
 from ramba_tpu import common
 from ramba_tpu.observe import registry as _registry
 from ramba_tpu.resilience import faults as _faults
+from ramba_tpu.resilience import integrity as _integrity
 
 _MARKER = ".ramba_cache"
+
+#: integrity-envelope schema tags for the two persisted record kinds
+AOT_SCHEMA = "aot.pkl"
+PROGRAM_SCHEMA = "program.pkl"
 _lock = threading.RLock()
 _state = {"dir": None, "armed": False, "init_error": None}
 
@@ -258,17 +263,27 @@ def lookup(fp: str, leaf_vals: Sequence, program, donate_key):
     try:
         with open(path, "rb") as f:
             raw = f.read()
-        payload = pickle.loads(raw)
+        # flip seam (RAMBA_FAULTS='aot:blob:flip:...'): seeded silent
+        # corruption of the just-read executable, upstream of the digest
+        raw = _faults.corrupt("aot:blob", raw, fp=fp)
+        payload = pickle.loads(
+            _integrity.unwrap(raw, AOT_SCHEMA, site="aot:blob"))
         if payload["fp"] != fp or payload["sig"] != sig:
             raise ValueError("entry key mismatch")
         from jax.experimental import serialize_executable as _se
 
         blob, in_tree, out_tree = payload["payload"]
         loaded = _se.deserialize_and_load(blob, in_tree, out_tree)
-    except Exception:  # noqa: BLE001 — tolerate any corruption shape
+    except Exception as e:  # noqa: BLE001 — tolerate any corruption shape
         with _lock:
             stats["corrupt"] += 1
         _registry.inc("compile.persist_corrupt")
+        if not isinstance(e, _integrity.IntegrityError):
+            # unwrap already classified digest failures; anything that
+            # passed the digest but failed to deserialize is its own
+            # integrity incident (fleet health must see corruption)
+            _integrity.failure("aot:blob", "deserialize",
+                               detail=repr(e)[:200], fp=fp)
         try:
             os.unlink(path)
         except OSError:
@@ -335,7 +350,8 @@ def _save_program(fp, program, donate_key, sig, compile_class) -> None:
         "compile_class": compile_class,
     }
     try:
-        _atomic_write(path, pickle.dumps(rec))
+        _atomic_write(path,
+                      _integrity.wrap(pickle.dumps(rec), PROGRAM_SCHEMA))
     except Exception:  # noqa: BLE001 — unpicklable statics: skip, count
         with _lock:
             stats["store_errors"] += 1
@@ -354,14 +370,19 @@ def load_program(fp: str) -> Optional[dict]:
         return None
     try:
         with open(path, "rb") as f:
-            rec = pickle.loads(f.read())
+            raw = f.read()
+        rec = pickle.loads(
+            _integrity.unwrap(raw, PROGRAM_SCHEMA, site="aot:program"))
         if rec["fp"] != fp:
             raise ValueError("program key mismatch")
         return rec
-    except Exception:  # noqa: BLE001
+    except Exception as e:  # noqa: BLE001
         with _lock:
             stats["corrupt"] += 1
         _registry.inc("compile.persist_corrupt")
+        if not isinstance(e, _integrity.IntegrityError):
+            _integrity.failure("aot:program", "deserialize",
+                               detail=repr(e)[:200], fp=fp)
         try:
             os.unlink(path)
         except OSError:
@@ -460,9 +481,11 @@ def store_entry(fp: str, sig: tuple, program_rec=None,
         from jax.experimental import serialize_executable as _se
 
         blob, in_tree, out_tree = _se.serialize(compiled)
-        data = pickle.dumps(
-            {"fp": fp, "sig": sig, "payload": (blob, in_tree, out_tree),
-             "writer": _writer_identity()})
+        data = _integrity.wrap(
+            pickle.dumps(
+                {"fp": fp, "sig": sig, "payload": (blob, in_tree, out_tree),
+                 "writer": _writer_identity()}),
+            AOT_SCHEMA)
         _atomic_write(path, data)
     except Exception:  # noqa: BLE001 — AOT store is best-effort
         with _lock:

@@ -71,6 +71,7 @@ from ramba_tpu.resilience import coherence as _coherence
 from ramba_tpu.resilience import degrade as _degrade
 from ramba_tpu.resilience import elastic as _elastic
 from ramba_tpu.resilience import faults as _faults
+from ramba_tpu.resilience import integrity as _integrity
 from ramba_tpu.resilience import memory as _memory
 from ramba_tpu.resilience.spill import SpilledArray as _SpilledArray
 from ramba_tpu.utils import timing as _timing
@@ -2027,7 +2028,22 @@ def _flush_dispatch_traced(work: "_FlushWork", *, coalesced: int = 0) -> list:
         _registry.inc(f"serve.tenant.{stream.tenant}.flushes")
         _registry.inc(f"serve.tenant.{stream.tenant}.nodes",
                       len(program.instrs))
-    if work.memo_plan is not None:
+    # Shadow recompute audit (RAMBA_AUDIT=<1-in-N>): re-execute a sample
+    # of effect-certified pure, non-donating flushes on the eager rung
+    # and compare byte identity — the tripwire for silent compute/memory
+    # corruption.  The primary outs are ALWAYS what gets served (audit
+    # on/off is byte-identical); a mismatch only suppresses the memo
+    # insert and evicts, so poison never enters a cache.
+    audit_mismatch = False
+    if (work.memo_plan is not None and work.memo_plan.certified
+            and not work.donate_key and rung == "fused"
+            and not work.memo_hit and _integrity.audit_every() > 0):
+        shadow_leaves = leaf_vals
+        audit_mismatch = _integrity.shadow_audit(
+            label, outs,
+            lambda: _run_eager(program, shadow_leaves, None),
+            plan=work.memo_plan, span=span)
+    if work.memo_plan is not None and not audit_mismatch:
         try:
             _memo.insert(work.memo_plan, list(outs))
         except Exception:

@@ -293,6 +293,24 @@ def _shared_tier():
     return _artifacts
 
 
+def evict(plan: Optional["MemoPlan"]) -> bool:
+    """Drop one plan's cached entry (and its census refs).  The
+    integrity plane calls this when a shadow audit disagreed with the
+    primary result — the cached bytes are suspect and must not be
+    served again."""
+    if plan is None or plan.key is None:
+        return False
+    with cache._lock:
+        e = cache._entries.pop(plan.key, None)
+        if e is None:
+            return False
+        cache.total_bytes -= e.nbytes
+        cache.evictions += 1
+    _release_entry(e)
+    _registry.inc("memo.evictions")
+    return True
+
+
 def reset() -> None:
     """Drop every cached result and its census refs (tests)."""
     cache.clear()

@@ -57,6 +57,7 @@ from ramba_tpu.observe import events as _events
 from ramba_tpu.observe import registry as _registry
 from ramba_tpu.resilience import coherence as _coherence
 from ramba_tpu.resilience import faults as _faults
+from ramba_tpu.resilience import integrity as _integrity
 
 _OFF = ("", "0", "off", "false", "no")
 
@@ -524,6 +525,10 @@ def _shared_tier() -> Optional[Any]:
     return _artifacts
 
 
+#: integrity-envelope schema tag for shared certificate blobs
+CERT_SCHEMA = "plancert.json"
+
+
 def _cert_path(tier: Any, chash: str) -> str:
     return os.path.join(tier.artifacts_dir(), "plancert",
                         f"{chash}.json")
@@ -543,7 +548,8 @@ def publish(cert: Optional[_plancert.PlanCertificate]) -> bool:
                           sort_keys=True).encode()
     except (TypeError, ValueError):
         return False
-    if not tier.store_blob(_cert_path(tier, cert.chash), data):
+    if not tier.store_blob(_cert_path(tier, cert.chash),
+                           _integrity.wrap(data, CERT_SCHEMA)):
         return False
     with _lock:
         _bump("publishes")
@@ -573,8 +579,16 @@ def _adopt_shared(program: Any, leaf_vals: Sequence[Any],
     if raw is None:
         return None
     try:
-        obj = json.loads(raw.decode())
-    except (ValueError, UnicodeDecodeError):
+        payload = _integrity.unwrap(raw, CERT_SCHEMA, site="plancert:blob")
+    except _integrity.IntegrityError:
+        # digest mismatch or unstamped pre-plane blob: evict, re-derive
+        tier.evict(_cert_path(tier, cf.chash))
+        return None
+    try:
+        obj = json.loads(payload.decode())
+    except (ValueError, UnicodeDecodeError) as e:
+        _integrity.failure("plancert:blob", "deserialize",
+                           detail=repr(e)[:200], chash=cf.chash)
         tier.evict(_cert_path(tier, cf.chash))
         return None
     cert = _plancert.from_payload(obj)

@@ -226,6 +226,15 @@ def plancache_report() -> dict:
     return _plancache.snapshot()
 
 
+def integrity_report() -> dict:
+    """Data-integrity plane snapshot (resilience/integrity.py): digests
+    stamped/verified, classified failures, shadow-audit verdicts and the
+    rolling suspect-window state."""
+    from ramba_tpu.resilience import integrity as _integrity
+
+    return _integrity.snapshot()
+
+
 def snapshot() -> dict:
     """Everything, JSON-serializable: registry stores + the event ring.
 
@@ -260,6 +269,9 @@ def snapshot() -> dict:
     plan = plancache_report()
     if plan["enabled"] or plan.get("lookups") or plan.get("stores"):
         snap["plancache"] = plan
+    integ = integrity_report()
+    if integ["stamped"] or integ["failures"] or integ["audits"]:
+        snap["integrity"] = integ
     return snap
 
 

@@ -51,8 +51,13 @@ import numpy as np
 
 from ramba_tpu import common as _common
 from ramba_tpu.observe import registry as _registry
+from ramba_tpu.resilience import faults as _faults
+from ramba_tpu.resilience import integrity as _integrity
 
 _OFF = ("0", "off", "false", "no")
+
+#: integrity-envelope schema tag for shared memo blobs
+MEMO_SCHEMA = "memo.npz"
 
 _lock = threading.Lock()
 _state = {"dir": None}
@@ -267,7 +272,8 @@ def memo_store(key: str, outs: Sequence[Any]) -> bool:
         with _lock:
             stats["memo_store_errors"] += 1
         return False
-    if not store_blob(_memo_path(key), buf.getvalue()):
+    if not store_blob(_memo_path(key),
+                      _integrity.wrap(buf.getvalue(), MEMO_SCHEMA)):
         with _lock:
             stats["memo_store_errors"] += 1
         _registry.inc("artifacts.memo_store_error")
@@ -291,13 +297,31 @@ def memo_load(key: str) -> Optional[List[np.ndarray]]:
             stats["memo_misses"] += 1
         _registry.inc("artifacts.memo_miss")
         return None
+    # flip seam (RAMBA_FAULTS='memo:blob:flip:...'): seeded silent
+    # corruption of the just-read bytes, upstream of verification
+    raw = _faults.corrupt("memo:blob", raw, key=key)
     try:
-        with np.load(io.BytesIO(raw), allow_pickle=False) as z:
-            arrays = [z[f"out{i}"] for i in range(len(z.files))]
-    except Exception:  # noqa: BLE001 — torn/corrupt blob means dead writer
+        payload = _integrity.unwrap(raw, MEMO_SCHEMA, site="memo:blob")
+    except _integrity.IntegrityError:
+        # digest mismatch or unstamped pre-plane entry: evict and let
+        # the caller recompute — never serve suspect bytes
         with _lock:
             stats["memo_corrupt"] += 1
         _registry.inc("artifacts.memo_corrupt")
+        evict(path)
+        return None
+    try:
+        with np.load(io.BytesIO(payload), allow_pickle=False) as z:
+            arrays = [z[f"out{i}"] for i in range(len(z.files))]
+    except Exception as e:  # noqa: BLE001 — torn blob that passed the
+        # digest means a dead writer's debris predating the stamp (or a
+        # schema drift): classify it as an integrity failure so fleet
+        # health sees corruption, then evict + recompute as before
+        with _lock:
+            stats["memo_corrupt"] += 1
+        _registry.inc("artifacts.memo_corrupt")
+        _integrity.failure("memo:blob", "deserialize",
+                           detail=repr(e)[:200], key=key)
         evict(path)
         return None
     with _lock:

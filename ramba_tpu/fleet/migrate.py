@@ -38,12 +38,35 @@ from typing import Any, Dict, Optional, Tuple
 from ramba_tpu.fleet import artifacts as _artifacts
 from ramba_tpu.observe import events as _events
 from ramba_tpu.observe import registry as _registry
+from ramba_tpu.resilience import faults as _faults
+from ramba_tpu.resilience import integrity as _integrity
 
 MANIFEST_SCHEMA = 1
 
 
 class MigrateError(RuntimeError):
     """The handoff is missing, torn, or structurally wrong."""
+
+
+def _payload_files(path: str) -> list:
+    """Every regular file under the handoff checkpoint, sorted by
+    relative path — the byte population the manifest's
+    ``payload_bytes`` covers."""
+    out = []
+    for root, _dirs, names in os.walk(path):
+        for name in names:
+            out.append(os.path.join(root, name))
+    return sorted(out)
+
+
+def _payload_bytes(path: str) -> int:
+    total = 0
+    for f in _payload_files(path):
+        try:
+            total += os.path.getsize(f)
+        except OSError:
+            pass
+    return total
 
 
 def _dir_for(sid: str, directory: Optional[str]) -> str:
@@ -76,6 +99,7 @@ def export_session(sid: str, meta: Dict[str, Any], state: Dict[str, Any],
         "schema": MANIFEST_SCHEMA,
         "sid": sid,
         "names": sorted(tree),
+        "payload_bytes": _payload_bytes(path),
         "saved_at": round(time.time(), 6),
         **{k: meta[k] for k in ("tenant", "trace_id", "seq") if k in meta},
     }
@@ -117,6 +141,23 @@ def adopt_session(sid: str, directory: Optional[str] = None) -> \
 
     manifest = load_manifest(sid, directory)
     path = _dir_for(sid, directory)
+    if _faults.configured("migrate:payload"):
+        # flip seam (RAMBA_FAULTS='migrate:payload:flip:...'): seeded
+        # corruption of the handoff payload before any check runs
+        files = _payload_files(path)
+        if files:
+            _faults.corrupt_file("migrate:payload", files[0], sid=sid)
+    want = manifest.get("payload_bytes")
+    if want is not None:
+        got = _payload_bytes(path)
+        if got != want:
+            # truncated / grown payload: the handoff is torn, and the
+            # cheap size census catches it before Orbax parses anything
+            _integrity.failure("migrate:payload", "length",
+                               detail=f"{got} != {want}", sid=sid)
+            raise MigrateError(
+                f"handoff payload for {sid!r} is {got} bytes but the "
+                f"manifest recorded {want} — torn or corrupt handoff")
     t0 = time.perf_counter()
     try:
         state = _checkpoint.restore(path)
@@ -142,7 +183,13 @@ def discard(sid: str, directory: Optional[str] = None) -> None:
         os.unlink(_manifest_path(sid, directory))
     except OSError:
         pass
-    shutil.rmtree(_dir_for(sid, directory), ignore_errors=True)
+    path = _dir_for(sid, directory)
+    try:
+        from ramba_tpu.checkpoint import digests_path as _digests_path
+        os.unlink(_digests_path(path))
+    except OSError:
+        pass
+    shutil.rmtree(path, ignore_errors=True)
 
 
 def list_handoffs(directory: Optional[str] = None) -> list:

@@ -185,7 +185,11 @@ def _signals() -> dict:
            # serving endpoint (host:port) when this process is a fleet
            # replica server — how the router joins a spool snapshot to
            # the connection it routes to (fleet/replica.py exports it)
-           "endpoint": os.environ.get("RAMBA_FLEET_ENDPOINT") or None}
+           "endpoint": os.environ.get("RAMBA_FLEET_ENDPOINT") or None,
+           # silent-corruption defense (resilience/integrity.py): digest
+           # or audit failures in the rolling window; past the threshold
+           # the replica is a corruption suspect -> routed around
+           "integrity_suspect": False, "integrity_failures": 0}
     try:
         from ramba_tpu.serve import overload as _overload
 
@@ -194,6 +198,13 @@ def _signals() -> dict:
         pass
     try:
         out["slo_breached"] = _slo.breached_tenants()
+    except Exception:
+        pass
+    try:
+        from ramba_tpu.resilience import integrity as _integrity
+
+        out["integrity_failures"] = _integrity.failure_count()
+        out["integrity_suspect"] = _integrity.suspect()
     except Exception:
         pass
     try:
@@ -354,6 +365,10 @@ def classify(entry: dict, now: Optional[float] = None) -> tuple:
     if breached:
         return DEGRADED, ("latched SLO breach: "
                           + ",".join(t or "(default)" for t in breached))
+    if sig.get("integrity_suspect"):
+        return DEGRADED, (f"integrity suspect: "
+                          f"{sig.get('integrity_failures', 0)} digest/audit "
+                          f"failure(s) in window")
     hb_iv = sig.get("heartbeat_interval_s")
     hb_age = sig.get("heartbeat_age_s")
     if (sig.get("heartbeat_running") and isinstance(hb_iv, (int, float))

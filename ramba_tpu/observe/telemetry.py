@@ -115,7 +115,7 @@ _events.set_context_provider(_context_fields)
 
 #: Event types that constitute an incident (each occurrence = one dump).
 FLIGHT_TRIGGERS = ("slow_flush", "stall", "slo_breach", "flush_error",
-                   "perf_regression")
+                   "perf_regression", "integrity")
 
 _flight_lock = threading.Lock()
 _flight_dumps = 0

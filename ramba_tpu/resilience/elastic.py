@@ -59,6 +59,7 @@ Env vars: ``RAMBA_WATCHDOG_S`` (deadline seconds; unset/0 disarms),
 from __future__ import annotations
 
 import contextvars
+import hashlib
 import json
 import os
 import threading
@@ -71,6 +72,7 @@ import numpy as np
 from ramba_tpu.observe import events as _events
 from ramba_tpu.observe import health as _health
 from ramba_tpu.observe import registry as _registry
+from ramba_tpu.resilience import integrity as _integrity
 from ramba_tpu.resilience import coherence as _coherence
 from ramba_tpu.resilience import faults as _faults
 from ramba_tpu.resilience import memory as _memory
@@ -344,6 +346,15 @@ _MANIFEST = "MANIFEST.json"
 _MANIFEST_FORMAT = 1
 
 
+def _manifest_digest(man: dict) -> str:
+    """Content digest over the manifest body (every field except the
+    digest itself, canonical JSON) — pre-digest manifests, which lack
+    the field, are accepted unverified."""
+    body = {k: v for k, v in man.items() if k != "digest"}
+    data = json.dumps(body, sort_keys=True).encode()
+    return hashlib.sha256(data).hexdigest()
+
+
 def _env_int(name: str, default: int) -> int:
     try:
         return int(os.environ.get(name, "") or default)
@@ -472,6 +483,16 @@ class CheckpointManager:
             if key not in man:
                 raise CheckpointCorruptError(
                     f"checkpoint manifest at {mpath!r} is missing {key!r}")
+        want = man.get("digest")
+        if want is not None and _integrity.enabled():
+            # self-digest over the manifest body: a flipped bit anywhere
+            # in the file (leaf fingerprints included) refuses the step
+            if _manifest_digest(man) != want:
+                _integrity.failure("checkpoint:leaf", "digest",
+                                   detail=f"manifest step {step}")
+                raise CheckpointCorruptError(
+                    f"checkpoint manifest at {mpath!r} failed its "
+                    f"self-digest (silent corruption)")
         return man
 
     def _write_manifest(self, step: int, vals) -> dict:
@@ -488,6 +509,7 @@ class CheckpointManager:
             "x64": bool(jax.config.jax_enable_x64),
             "leaves": _leaf_fingerprints(vals),
         }
+        man["digest"] = _manifest_digest(man)
         if jax.process_index() == 0:
             mpath = self.manifest_path(step)
             tmp = mpath + ".tmp"

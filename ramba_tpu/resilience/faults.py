@@ -36,6 +36,21 @@ they fire on every check.  ``dispatch:hang:ms=500:after=2`` hangs the
 third dispatch only, which is how the watchdog and heartbeat-miss
 tests seed a stall without flaky timing.
 
+* ``flip:bytes=<n>`` is the silent-data-corruption mode: it never
+  raises and never fires from :func:`check` — instead the payload-
+  carrying seams pass their bytes through :func:`corrupt` (or point
+  :func:`corrupt_file` at an on-disk blob), and the harness XORs ``n``
+  bytes (default 1) at deterministic offsets drawn from
+  ``RAMBA_FAULTS_SEED`` + site + call number.  Like ``delay``/``hang``
+  it takes an optional one-shot ``after=<k>`` payload (checks 1..k
+  pass untouched, check ``k+1`` flips) and composes with ``rank=<i>``
+  for rank-skewed corruption.  The wired sites are ``memo:blob``,
+  ``aot:blob``, ``checkpoint:leaf``, ``migrate:payload`` and
+  ``audit:shadow`` (resilience/integrity.py) —
+  ``RAMBA_FAULTS='memo:blob:flip:bytes=2:rank=1'`` flips two bytes of
+  every shared-memo blob rank 1 reads, the seeded corruption the
+  digest-verification path must catch.
+
 Every spec additionally accepts a ``rank=<i>`` *payload* (composes with
 ``after=<k>``, ``ms=<n>``, ``bytes=<n>`` and every mode): the spec only
 *fires* on SPMD rank ``i`` (``jax.process_index()``), while the per-site
@@ -186,7 +201,7 @@ def _is_mode_token(tok: str) -> bool:
     """True iff ``tok`` is a valid mode field — the site/mode boundary
     marker for colon-containing site names (``reshard:stage``)."""
     tok = tok.strip().lower()
-    if tok in ("once", "always", "delay", "hang"):
+    if tok in ("once", "always", "delay", "hang", "flip"):
         return True
     if tok.startswith("after="):
         try:
@@ -288,6 +303,18 @@ def _parse_one(chunk: str) -> _Spec:
                 f"bad RAMBA_FAULTS spec {chunk!r}: {mode} needs ms=<n>")
         return _Spec(site, mode, mode, delay_ms=delay_ms, after_n=after_n,
                      rank_i=rank_i)
+    if mode == "flip":
+        # silent corruption, not failure: the site's corrupt()/
+        # corrupt_file() seam XORs bytes, never raises.  Same one-shot
+        # after=<k> payload shape as delay/hang.
+        if kind:
+            raise ValueError(
+                f"bad RAMBA_FAULTS spec {chunk!r}: flip takes no kind")
+        if delay_ms is not None:
+            raise ValueError(
+                f"bad RAMBA_FAULTS spec {chunk!r}: flip takes no ms=")
+        return _Spec(site, "flip", "flip", nbytes=nbytes or 1,
+                     after_n=after_n, rank_i=rank_i)
     if delay_ms is not None:
         raise ValueError(
             f"bad RAMBA_FAULTS spec {chunk!r}: ms= only valid with "
@@ -295,7 +322,8 @@ def _parse_one(chunk: str) -> _Spec:
     if after_n is not None:
         raise ValueError(
             f"bad RAMBA_FAULTS spec {chunk!r}: after= payload only valid "
-            f"with delay/hang (use the after=N mode for raising faults)")
+            f"with delay/hang/flip (use the after=N mode for raising "
+            f"faults)")
     if not kind:
         kind = "oom" if site == "oom" else "transient"
     if mode == "once":
@@ -383,10 +411,10 @@ def stats() -> Dict[str, dict]:
 def _should_fire(sp: _Spec) -> bool:
     if sp.mode == "once":
         return sp.fired == 0
-    if sp.mode in ("delay", "hang"):
+    if sp.mode in ("delay", "hang", "flip"):
         if sp.after_n is None:
             return True
-        # one-shot: checks 1..k pass, check k+1 sleeps, later checks pass
+        # one-shot: checks 1..k pass, check k+1 fires, later checks pass
         return sp.calls == sp.after_n + 1
     if sp.mode == "always":
         return True
@@ -421,6 +449,10 @@ def check(site: str, **ctx) -> None:
         sp = _specs.get(site)
         if sp is None:
             return
+        if sp.kind == "flip":
+            # byte-flip specs fire only through corrupt()/corrupt_file(),
+            # which own the call counter for that site
+            return
         sp.calls += 1
         if sp.rank_i is not None and sp.rank_i != _process_index():
             # rank-skewed spec: the call counter advances on every rank
@@ -454,6 +486,71 @@ def check(site: str, **ctx) -> None:
     if kind == "fatal":
         raise InjectedFatalFault(site, call, "injected fatal")
     raise InjectedFault(site, call)
+
+
+def corrupt(site: str, data: Optional[bytes], **ctx) -> Optional[bytes]:
+    """Pass a payload through the byte-flip seam at ``site``.
+
+    Identity (and allocation-free) when no ``flip`` spec targets the
+    site; otherwise XORs ``bytes=<n>`` bytes at offsets drawn from a
+    PRNG seeded by (seed, site, call number) — deterministic across
+    reruns and across ranks, with ``rank=``/``after=`` composing the
+    same way they do for ``delay``/``hang``.  ``None``/empty payloads
+    pass through untouched (there is nothing to flip in them)."""
+    if not _specs or not data:
+        return data
+    with _lock:
+        sp = _specs.get(site)
+        if sp is None or sp.kind != "flip":
+            return data
+        sp.calls += 1
+        if sp.rank_i is not None and sp.rank_i != _process_index():
+            return data
+        if not _should_fire(sp):
+            return data
+        sp.fired += 1
+        call = sp.calls
+        n = max(1, int(sp.nbytes or 1))
+    rng = random.Random(f"{_seed}:{site}:{call}:flip")
+    buf = bytearray(data)
+    offsets = sorted({rng.randrange(len(buf))
+                      for _ in range(min(n, len(buf)))})
+    for i in offsets:
+        buf[i] ^= 0xFF
+    _registry.inc("resilience.fault_injected")
+    _registry.inc(f"resilience.fault_injected.{site}")
+    ev = {"type": "fault", "site": site, "call": call, "mode": "flip",
+          "kind": "flip", "bytes": len(offsets), "offsets": offsets}
+    ev.update(ctx)
+    _events.emit(ev)
+    return bytes(buf)
+
+
+def corrupt_file(site: str, path: str, **ctx) -> bool:
+    """On-disk variant of :func:`corrupt`: flip bytes of the file at
+    ``path`` in place (plain overwrite — this *is* the injected torn
+    write).  Returns True iff the file was actually flipped.  Missing
+    files and unarmed sites are no-ops."""
+    if not _specs:
+        return False
+    with _lock:
+        sp = _specs.get(site)
+        if sp is None or sp.kind != "flip":
+            return False
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return False
+    flipped = corrupt(site, data, path=path, **ctx)
+    if flipped == data or flipped is None:
+        return False
+    try:
+        with open(path, "wb") as f:
+            f.write(flipped)
+    except OSError:
+        return False
+    return True
 
 
 @contextmanager

@@ -42,6 +42,50 @@ JAX_PLATFORMS=cpu python -m ramba_tpu.analyze --memo-audit "${traces[@]}" || rc=
 echo "== lint.sh: ramba-lint --plan-audit =="
 JAX_PLATFORMS=cpu python -m ramba_tpu.analyze --plan-audit "${traces[@]}" || rc=1
 
+echo "== lint.sh: ramba-fsck smoke (seed, verify, flip, repair) =="
+ftd="$(mktemp -d)"
+if JAX_PLATFORMS=cpu RAMBA_ARTIFACTS="$ftd" python - <<'EOF'
+import os
+import sys
+
+import numpy as np
+
+from ramba_tpu.fleet import artifacts
+
+sys.path.insert(0, os.path.join(os.getcwd(), "scripts"))
+import ramba_fsck  # noqa: E402
+
+artifacts.configure()
+assert artifacts.memo_store("fscksmoke0" * 3 + "ab", [np.arange(16.0)])
+assert artifacts.memo_store("fscksmoke1" * 3 + "cd", [np.ones(4)])
+root = os.environ["RAMBA_ARTIFACTS"]
+
+r = ramba_fsck.scan(artifacts=root)
+assert r["status"] == 0 and r["scanned"] >= 2, r
+
+blob = os.path.join(root, "memo", sorted(os.listdir(os.path.join(root, "memo")))[0])
+b = bytearray(open(blob, "rb").read())
+b[len(b) // 2] ^= 0xFF
+open(blob, "wb").write(bytes(b))
+
+r = ramba_fsck.scan(artifacts=root)
+assert r["status"] == 1 and r["corrupt"] == 1, r
+
+r = ramba_fsck.scan(artifacts=root, repair=True)
+assert r["status"] == 1 and os.path.isdir(os.path.join(root, "quarantine")), r
+
+r = ramba_fsck.scan(artifacts=root)
+assert r["status"] == 0, r
+print("fsck smoke: detect + quarantine + clean rescan OK")
+EOF
+then
+    :
+else
+    echo "lint.sh: ramba-fsck smoke FAILED"
+    rc=1
+fi
+rm -rf "$ftd"
+
 if command -v ruff >/dev/null 2>&1; then
     echo "== lint.sh: ruff =="
     ruff check ramba_tpu tests scripts bench.py || rc=1

@@ -40,3 +40,5 @@ echo "=== leg 17: fleet observability federation (3 publishers + collector, kill
 python scripts/two_process_suite.py --fleet-leg
 echo "=== leg 18: fleet serving plane (router + replicas, shared artifact tier, kill-mid-soak failover) ==="
 python scripts/two_process_suite.py --router-leg
+echo "=== leg 19: data integrity plane (2-rank agreed audit verdict; RAMBA_INTEGRITY=0 wrong-answer repro) ==="
+python scripts/two_process_suite.py --integrity-leg

@@ -145,6 +145,18 @@ the survivor, and every tenant's digest stays byte-identical.  The
 stitched ``trace_report.py --merge-ranks`` / ``--trace`` views over the
 router + replica trace files must show the redirect/heal chain.
 
+``--integrity-leg`` runs the data-integrity acceptance leg (two
+phases).  ON: a 2-rank SPMD run with shadow audits armed
+(``RAMBA_AUDIT=1``) and a seeded one-shot flip of rank 1's shadow bytes
+(``audit:shadow:flip``) — both ranks must agree the audit verdict via
+the coherence round (rank 0 saw no local mismatch yet records the
+agreed one), suppress the memo insert coherently, serve the correct
+primary result, and emit ``integrity`` trace events.  OFF: a
+single-process reproduction of the exact wrong-answer serve the plane
+prevents — a shared memo blob clobbered with a *valid but wrong*
+unstamped payload is served verbatim under ``RAMBA_INTEGRITY=0``, then
+caught (evict + recompute, correct answer) with the plane on.
+
 ``--memo-leg`` runs the result-memoization acceptance leg: both ranks
 under ``RAMBA_MEMO=1`` canonicalize the same program (including its
 commutative-operand swap — ``analyze.canonicalize`` must produce the
@@ -3036,6 +3048,269 @@ def run_fault_leg() -> int:
     return 0 if ok else 1
 
 
+
+
+# SPMD workload for the integrity leg's ON phase: both ranks flush
+# three distinct effect-certified pure programs under RAMBA_AUDIT=1 so
+# every flush is shadow-audited.  The harness arms
+# RAMBA_FAULTS='audit:shadow:flip:bytes=1:rank=1:after=1' — exactly one
+# audit, on rank 1 only, sees flipped shadow bytes.  The verdict is
+# agreed via coherence.agree(reduce="max"), so BOTH ranks must count
+# the same single mismatch, suppress the same memo insert, and still
+# serve the correct primary values.  argv: <rank> <coordinator>.
+_INTEGRITY_WORKLOAD = """
+import sys
+import numpy as np
+rank, coord = int(sys.argv[1]), sys.argv[2]
+from ramba_tpu.parallel import distributed
+distributed.initialize(coordinator_address=coord, num_processes=2,
+                       process_id=rank)
+import jax
+assert jax.process_count() == 2, jax.process_count()
+import ramba_tpu as rt
+from ramba_tpu.core import memo
+from ramba_tpu.resilience import integrity
+assert memo.enabled(), 'RAMBA_MEMO not armed'
+assert integrity.audit_every() == 1, 'RAMBA_AUDIT not armed'
+a = rt.arange(4096) / 100.0
+b = rt.arange(4096) * 0.5 + 1.0
+rt.sync()
+vals = [float(rt.sum((a + b) * k)) for k in (2.0, 3.0, 4.0)]
+an = np.arange(4096)
+base = an / 100.0 + (an * 0.5 + 1.0)
+for k, v in zip((2.0, 3.0, 4.0), vals):
+    exp = float(np.sum(base * k))
+    assert abs(v - exp) <= 1e-4 * abs(exp), (k, v, exp)
+snap = integrity.snapshot()
+assert snap['audits'] >= 3, snap
+assert snap['audit_mismatches'] == 1, snap
+assert snap['audit_errors'] == 0, snap
+msnap = memo.cache.snapshot()
+print('INTEGRITY_LEG rank=%d audits=%d mismatches=%d inserts=%d '
+      'checksum=%.6f' % (rank, snap['audits'], snap['audit_mismatches'],
+                         msnap['inserts'], sum(vals)))
+"""
+
+
+# Single-process workloads for the integrity leg's OFF phase.  Seed:
+# flush one memoizable program with the shared artifact tier armed so a
+# stamped memo blob lands on disk; print the correct value and the blob
+# path.  Probe: a fresh process recomputes the same program — the
+# shared lane is keyed by content, so it adopts whatever the blob
+# holds.  Between seed and probe the harness replaces the blob with a
+# VALID but WRONG unstamped npz: with RAMBA_INTEGRITY=0 the probe
+# serves the wrong answer verbatim (the failure mode this plane
+# exists to stop); with the plane on the unstamped blob is evicted and
+# the recompute serves the correct answer.
+_INTEGRITY_SEED_WORKLOAD = """
+import os
+import numpy as np
+import ramba_tpu as rt
+from ramba_tpu.core import memo
+from ramba_tpu.fleet import artifacts
+assert memo.enabled() and artifacts.memo_shared_enabled()
+x = rt.fromarray(np.arange(256) * 0.5)
+v = float(rt.sum(x * 3.0 + 1.0))
+memo_dir = os.path.join(os.environ['RAMBA_ARTIFACTS'], 'memo')
+blobs = sorted(n for n in os.listdir(memo_dir) if n.endswith('.npz'))
+assert len(blobs) == 1, blobs
+print('INTEGRITY_SEED value=%.6f blob=%s' % (v, blobs[0]))
+"""
+
+_INTEGRITY_PROBE_WORKLOAD = """
+import numpy as np
+import ramba_tpu as rt
+from ramba_tpu.core import memo
+from ramba_tpu.fleet import artifacts
+from ramba_tpu.resilience import integrity
+x = rt.fromarray(np.arange(256) * 0.5)
+v = float(rt.sum(x * 3.0 + 1.0))
+snap = artifacts.snapshot()
+print('INTEGRITY_PROBE value=%.6f shared_hits=%d corrupt=%d '
+      'failures=%d' % (v, snap['memo_hits'], snap['memo_corrupt'],
+                       integrity.stats['failures']))
+"""
+
+
+def run_integrity_leg() -> int:
+    """Two phases: (ON) 2-rank coherent shadow-audit verdict under a
+    seeded rank-1 shadow flip; (OFF) the wrong-answer serve reproduced
+    with RAMBA_INTEGRITY=0 and caught with the plane on."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    basetemp = tempfile.mkdtemp(prefix="ramba_2proc_integrity_")
+    trace_base = os.path.join(basetemp, "trace.jsonl")
+    budget = float(os.environ.get("RAMBA_TEST_PROCS_TIMEOUT", "600"))
+    ok = True
+
+    # -- ON phase: coherent audit verdict across ranks -------------------
+    procs, logs = [], []
+    for rank in range(2):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        for k in ("RAMBA_TEST_PROCS", "RAMBA_TEST_PROC_ID",
+                  "RAMBA_TEST_COORD", "RAMBA_TEST_SHARED_TMP",
+                  "RAMBA_PROFILE_DIR", "RAMBA_HBM_BUDGET",
+                  "RAMBA_MEMO_BUDGET", "RAMBA_ARTIFACTS",
+                  "RAMBA_INTEGRITY"):
+            env.pop(k, None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["RAMBA_MEMO"] = "1"
+        env["RAMBA_AUDIT"] = "1"
+        env["RAMBA_FAULTS"] = "audit:shadow:flip:bytes=1:rank=1:after=1"
+        env["RAMBA_TRACE"] = trace_base
+        log = open(os.path.join(basetemp, f"rank{rank}.log"), "w")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _INTEGRITY_WORKLOAD, str(rank),
+             f"localhost:{port}"],
+            env=env, stdout=log, stderr=subprocess.STDOUT, cwd=REPO,
+        ))
+    deadline = time.time() + budget
+    rcs = [None, None]
+    try:
+        for i, p in enumerate(procs):
+            left = max(5.0, deadline - time.time())
+            try:
+                rcs[i] = p.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                rcs[i] = -9
+    finally:
+        for log in logs:
+            log.close()
+    ok = all(rc == 0 for rc in rcs)
+
+    markers = [None, None]
+    for rank in range(2):
+        path = os.path.join(basetemp, f"rank{rank}.log")
+        with open(path) as f:
+            tail = f.read().splitlines()
+        for line in tail:
+            if line.startswith(f"INTEGRITY_LEG rank={rank} "):
+                markers[rank] = line.split(" ", 2)[2]
+        if markers[rank] is None:
+            ok = False
+        print(f"--- integrity leg rank {rank} rc={rcs[rank]} ({path}) ---")
+        print("\n".join(tail[-(4 if ok else 40):]))
+    if ok and markers[0] != markers[1]:
+        print(f"integrity leg: FAIL (rank skew: r0={markers[0]} "
+              f"r1={markers[1]})")
+        ok = False
+    elif ok:
+        print(f"integrity leg ON: agreed verdict across ranks "
+              f"({markers[0]})")
+
+    # The agreed mismatch must be visible as an ``integrity`` trace
+    # event on BOTH ranks (rank 0 had no local mismatch — the event is
+    # the coherently-agreed one).
+    import json
+
+    for rank in range(2):
+        path = f"{trace_base}.rank{rank}"
+        try:
+            with open(path) as f:
+                evs = [json.loads(ln) for ln in f if ln.strip()]
+            n_int = sum(1 for e in evs if e.get("type") == "integrity"
+                        and e.get("site") == "audit:shadow")
+            print(f"integrity leg rank {rank}: {len(evs)} events, "
+                  f"{n_int} integrity events")
+            if n_int < 1:
+                ok = False
+        except (OSError, ValueError) as e:
+            print(f"integrity leg rank {rank}: FAIL ({e})")
+            ok = False
+
+    # -- OFF phase: the wrong-answer serve, reproduced then caught -------
+    art = os.path.join(basetemp, "artifacts")
+    os.makedirs(art, exist_ok=True)
+
+    def run_single(workload, *, integrity_on):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        for k in ("RAMBA_TEST_PROCS", "RAMBA_TEST_PROC_ID",
+                  "RAMBA_TEST_COORD", "RAMBA_TEST_SHARED_TMP",
+                  "RAMBA_FAULTS", "RAMBA_TRACE", "RAMBA_AUDIT"):
+            env.pop(k, None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["RAMBA_MEMO"] = "1"
+        env["RAMBA_ARTIFACTS"] = art
+        env["RAMBA_INTEGRITY"] = "1" if integrity_on else "0"
+        return subprocess.run(
+            [sys.executable, "-c", workload], env=env, cwd=REPO,
+            capture_output=True, text=True, timeout=budget)
+
+    r = run_single(_INTEGRITY_SEED_WORKLOAD, integrity_on=True)
+    seed_val, blob = None, None
+    for line in r.stdout.splitlines():
+        if line.startswith("INTEGRITY_SEED "):
+            fields = dict(f.split("=", 1) for f in line.split()[1:])
+            seed_val = float(fields["value"])
+            blob = os.path.join(art, "memo", fields["blob"])
+    if r.returncode != 0 or blob is None:
+        print(f"integrity leg OFF: seed FAILED rc={r.returncode}\n"
+              f"{r.stdout[-2000:]}{r.stderr[-2000:]}")
+        ok = False
+    else:
+        # Clobber: a VALID npz of wrong values, UNSTAMPED — the shape a
+        # pre-plane cache poisoning takes.  (A bit flip inside the npz
+        # usually trips zipfile's CRC; this is the flip that parses.)
+        import io
+
+        import numpy as np
+
+        wrong = np.full(1, -12345.0)
+        buf = io.BytesIO()
+        np.savez(buf, out0=wrong)
+        with open(blob, "wb") as f:
+            f.write(buf.getvalue())
+
+        r_off = run_single(_INTEGRITY_PROBE_WORKLOAD, integrity_on=False)
+        r_on = run_single(_INTEGRITY_PROBE_WORKLOAD, integrity_on=True)
+
+        def probe_fields(r):
+            for line in r.stdout.splitlines():
+                if line.startswith("INTEGRITY_PROBE "):
+                    return dict(f.split("=", 1)
+                                for f in line.split()[1:])
+            return None
+
+        f_off, f_on = probe_fields(r_off), probe_fields(r_on)
+        if r_off.returncode != 0 or f_off is None:
+            print(f"integrity leg OFF: probe FAILED rc={r_off.returncode}"
+                  f"\n{r_off.stdout[-2000:]}{r_off.stderr[-2000:]}")
+            ok = False
+        elif not (float(f_off["value"]) == -12345.0
+                  and int(f_off["shared_hits"]) >= 1):
+            print(f"integrity leg OFF: wrong-answer serve NOT reproduced "
+                  f"({f_off} vs seed {seed_val})")
+            ok = False
+        else:
+            print(f"integrity leg OFF: RAMBA_INTEGRITY=0 served the "
+                  f"poisoned value {f_off['value']} (seed {seed_val:g})")
+        if r_on.returncode != 0 or f_on is None:
+            print(f"integrity leg ON: probe FAILED rc={r_on.returncode}"
+                  f"\n{r_on.stdout[-2000:]}{r_on.stderr[-2000:]}")
+            ok = False
+        elif not (abs(float(f_on["value"]) - seed_val) <= 1e-6
+                  and int(f_on["corrupt"]) >= 1
+                  and int(f_on["failures"]) >= 1):
+            print(f"integrity leg ON: poisoned blob not caught ({f_on})")
+            ok = False
+        else:
+            print(f"integrity leg ON: unstamped blob evicted "
+                  f"(corrupt={f_on['corrupt']}), recomputed correct "
+                  f"value {f_on['value']}")
+
+    print(f"two-process integrity leg: {'OK' if ok else 'FAIL'}")
+    if ok:
+        shutil.rmtree(basetemp, ignore_errors=True)
+    return 0 if ok else 1
+
+
 def main() -> int:
     if "--fault-leg" in sys.argv[1:]:
         return run_fault_leg()
@@ -3061,6 +3336,8 @@ def main() -> int:
         return run_router_leg()
     if "--autotune-leg" in sys.argv[1:]:
         return run_autotune_leg()
+    if "--integrity-leg" in sys.argv[1:]:
+        return run_integrity_leg()
     if "--memo-leg" in sys.argv[1:]:
         return run_memo_leg()
     if "--plancache-leg" in sys.argv[1:]:

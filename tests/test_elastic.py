@@ -329,6 +329,9 @@ def test_mesh_shape_mismatch_without_target_raises(tmp_path):
     man = mgr.manifest(1)
     man["process_count"] = int(man["process_count"]) + 1
     man["mesh_devices"] = int(man["mesh_devices"]) * 2
+    # re-stamp: this models a DIFFERENT environment writing a valid
+    # manifest, not at-rest corruption (that path is test_integrity.py)
+    man["digest"] = elastic._manifest_digest(man)
     with open(mgr.manifest_path(1), "w") as f:
         json.dump(man, f)
     with pytest.raises(CheckpointCorruptError, match="elastic.resume"):
@@ -350,6 +353,9 @@ def test_x64_flag_mismatch_raises(tmp_path):
     mgr.save(1)
     man = mgr.manifest(1)
     man["x64"] = not man["x64"]
+    # re-stamp: this models a DIFFERENT environment writing a valid
+    # manifest, not at-rest corruption (that path is test_integrity.py)
+    man["digest"] = elastic._manifest_digest(man)
     with open(mgr.manifest_path(1), "w") as f:
         json.dump(man, f)
     with pytest.raises(CheckpointCorruptError, match="jax_enable_x64"):
@@ -367,6 +373,9 @@ def test_manifest_leaf_count_mismatch_raises(tmp_path):
     mgr.save(1)
     man = mgr.manifest(1)
     man["leaves"] = man["leaves"][:1]
+    # re-stamp: this models a DIFFERENT environment writing a valid
+    # manifest, not at-rest corruption (that path is test_integrity.py)
+    man["digest"] = elastic._manifest_digest(man)
     with open(mgr.manifest_path(1), "w") as f:
         json.dump(man, f)
     with pytest.raises(CheckpointCorruptError, match="leaves"):
