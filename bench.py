@@ -559,15 +559,25 @@ def _bench_plancache(rt, platform):
     flush pays after the analysis pipeline is skipped) and
     ``plan_fast_path_speedup`` (miss-path p50 prepare+verify over the
     hit-path p50 from the stage waterfalls; the PR-18 acceptance bar is
-    >= 10x)."""
+    >= 10x).
+
+    The whole section runs under ``RAMBA_ATTRIB=sample:16`` — the
+    production posture for repeat serving traffic — so
+    ``fast_path_floor_us`` is the floor a sampled-attribution deployment
+    actually pays (the 1-in-16 fence never lands in the p50), and both
+    miss and hit phases see the same fencing policy."""
     import os
 
     from ramba_tpu.core import plancache as _plancache
+    from ramba_tpu.observe import attrib as _attrib
     from ramba_tpu.observe import events as _events
 
     saved_pc = os.environ.get("RAMBA_PLANCERT")
     saved_vf = os.environ.get("RAMBA_VERIFY")
+    saved_at = os.environ.get("RAMBA_ATTRIB")
     os.environ["RAMBA_VERIFY"] = "strict"
+    os.environ["RAMBA_ATTRIB"] = "sample:16"
+    _attrib.reconfigure()
     _plancache.reset()
     out = {}
 
@@ -640,11 +650,13 @@ def _bench_plancache(rt, platform):
             out["plan_waterfall_10x"] = bool(m50 / h50 >= 10.0)
     finally:
         for k, v in (("RAMBA_PLANCERT", saved_pc),
-                     ("RAMBA_VERIFY", saved_vf)):
+                     ("RAMBA_VERIFY", saved_vf),
+                     ("RAMBA_ATTRIB", saved_at)):
             if v is None:
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+        _attrib.reconfigure()
         _plancache.reset()
     return out
 
@@ -657,7 +669,11 @@ def _bench_observe(rt, platform):
     cost of RAMBA_TRACE JSONL on a flush loop, on vs off — the number
     that must stay under the 5% budget), and ``observe_scrape_ms`` (one
     full Prometheus render of every live snapshot — what a scraper
-    actually waits on)."""
+    actually waits on).  Two more ride on the observer-tax ledger:
+    ``observer_tax_frac`` (self-accounted observability wall over flush
+    wall at RAMBA_ATTRIB=sample:16 — the < 2% self-metering bar) and
+    ``trace_bytes_per_flush`` (JSONL bytes the full-fidelity file lane
+    costs per flush — what RAMBA_TRACE_SAMPLE exists to shrink)."""
     import os
     import tempfile
 
@@ -700,6 +716,47 @@ def _bench_observe(rt, platform):
         finally:
             _events.configure(saved_path)
     out["observe_flush_overhead_pct"] = round(100.0 * (on - off) / off, 2)
+
+    # observer tax + trace volume under sampled attribution: a traced
+    # flush loop at RAMBA_ATTRIB=sample:16, then read the observability
+    # wall back out of the self-accounting ledger.  tax_frac is
+    # (events + fence + ledger + telemetry + fleet + flight seconds) /
+    # attributed flush wall — the plane metering itself; perf_diff gates
+    # it < 0.02.  trace_bytes_per_flush is the full-fidelity file-lane
+    # cost per flush (head sampling would divide it, but bytes under
+    # sampling depend on which uuids hash in — not a stable gate).
+    from ramba_tpu.observe import attrib as _attrib
+    from ramba_tpu.observe import observer as _observer
+
+    saved_attrib = os.environ.get("RAMBA_ATTRIB")
+    os.environ["RAMBA_ATTRIB"] = "sample:16"
+    _attrib.reconfigure()
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            tpath = os.path.join(td, "bench_tax.jsonl")
+            _events.configure(tpath)
+            try:
+                loop()  # warm: compile + open the sink outside the window
+                _events.sync()
+                sz0 = os.path.getsize(tpath) if os.path.exists(tpath) else 0
+                _attrib.reset()
+                _observer.reset()
+                loop()
+                _events.sync()
+                frac = _observer.tax_frac()
+                if frac is not None:
+                    out["observer_tax_frac"] = frac
+                sz1 = os.path.getsize(tpath) if os.path.exists(tpath) else sz0
+                out["trace_bytes_per_flush"] = round(
+                    max(0, sz1 - sz0) / loops, 1)
+            finally:
+                _events.configure(saved_path)
+    finally:
+        if saved_attrib is None:
+            os.environ.pop("RAMBA_ATTRIB", None)
+        else:
+            os.environ["RAMBA_ATTRIB"] = saved_attrib
+        _attrib.reconfigure()
 
     # scrape latency: full render of registry + ledger + memory + slo +
     # elastic (the exporter HTTP handler is this plus socket writes)

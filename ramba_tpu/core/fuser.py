@@ -62,6 +62,7 @@ from ramba_tpu.observe import attrib as _attrib
 from ramba_tpu.observe import events as _events
 from ramba_tpu.observe import fleet as _fleet
 from ramba_tpu.observe import ledger as _ledger
+from ramba_tpu.observe import observer as _observer
 from ramba_tpu.observe import profile as _profile
 from ramba_tpu.observe import registry as _registry
 from ramba_tpu.observe import slo as _slo
@@ -958,15 +959,22 @@ def _execute_compiled(fn, program: _Program, leaf_vals, is_new: bool,
     dt = time.perf_counter() - t0
     sync_dt = None
     fence_dt = None
-    if _attrib.fence_enabled() or _ledger.sync_timing():
-        # Always-on cheap device fence: dt above stays the dispatch-time
-        # measurement every existing consumer sees; the fence window is
-        # the on-device tail the stage ledger files as device_execute.
+    # Cheap device fence: dt above stays the dispatch-time measurement
+    # every existing consumer sees; the fence window is the on-device
+    # tail the stage ledger files as device_execute.  Under
+    # RAMBA_ATTRIB=sample:<N> the fence fires 1-in-N calls per
+    # fingerprint (deterministic — see attrib.fence_decision), so the
+    # steady state stops paying the serialization tax on every flush.
+    if _attrib.fence_decision(fp, span) or _ledger.sync_timing():
         try:
             jax.block_until_ready(outs)
             fence_dt = time.perf_counter() - t0 - dt
         except Exception:
             fence_dt = None
+        if fence_dt is not None:
+            # the fence wait is observability's own cost: the device tail
+            # would have overlapped the host had we not blocked on it
+            _observer.add("fence", fence_dt)
         if fence_dt is not None and _ledger.sync_timing():
             # RAMBA_PERF=sync: a second, device-synchronized sample.
             sync_dt = dt + fence_dt
@@ -993,6 +1001,14 @@ def _execute_compiled(fn, program: _Program, leaf_vals, is_new: bool,
             _attrib.record_device(fp, _program_label(program),
                                   time.perf_counter() - t_call,
                                   backend=backend)
+        elif fence_dt is None and not is_new and _attrib.sampling():
+            # unfenced sampled call: carry the rolling fenced p50 as an
+            # estimate on the span (display-only — never a stage, the
+            # device tail genuinely overlaps the host here)
+            est = _attrib.estimated_device_s(fp)
+            if est is not None and span is not None:
+                span["device_est_s"] = round(
+                    span.get("device_est_s", 0.0) + est, 6)
     if span is not None:
         if is_new:
             # first call pays trace+lower+XLA compile; the pre-call
@@ -1826,6 +1842,11 @@ def _flush_prepare(stream: FlushStream, roots: list,
         work.deadline = _overload.mint_deadline(stream.deadline_ms)
         if work.deadline is not None:
             span["deadline_ms"] = work.deadline.budget_ms
+    # The kernel fingerprint rides the span so offline tooling and the
+    # incident explainer can join a flush back to its per-fingerprint
+    # baselines without the live ledger.
+    if work.fingerprint is not None:
+        span["fingerprint"] = work.fingerprint
     # Caller-thread attribution: "trace" is linearize + fuse + leaf
     # gather + donation census (unavoidable per flush); "prepare" is the
     # analysis pipeline from there on — class/memo/plan certification or
@@ -1857,6 +1878,7 @@ def _revalidate_donation(work: "_FlushWork") -> None:
         work.fingerprint = _ledger.fingerprint(_cache_key(
             work.program, kept,
             work.class_plan.token if work.class_plan is not None else None))
+        work.span["fingerprint"] = work.fingerprint
 
 
 def _finish_memo_hit(work: "_FlushWork") -> list:
@@ -2205,6 +2227,9 @@ def sync() -> None:
          if isinstance(a._expr, Const)
          and isinstance(a._expr.value, jax.Array)]  # spilled: nothing in flight
     )
+    # a sync is a "the world is settled" point: the buffered trace
+    # writer's pending lines belong on disk too
+    _events.sync()
 
 
 def evaluate(expr: Expr):

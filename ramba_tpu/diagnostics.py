@@ -235,6 +235,15 @@ def integrity_report() -> dict:
     return _integrity.snapshot()
 
 
+def observer_report() -> dict:
+    """Observer-tax ledger snapshot (observe/observer.py): wall seconds
+    the observability plane billed itself, per component, plus the tax
+    as a fraction of attributed flush wall."""
+    from ramba_tpu.observe import observer as _observer
+
+    return _observer.snapshot()
+
+
 def snapshot() -> dict:
     """Everything, JSON-serializable: registry stores + the event ring.
 
@@ -272,6 +281,9 @@ def snapshot() -> dict:
     integ = integrity_report()
     if integ["stamped"] or integ["failures"] or integ["audits"]:
         snap["integrity"] = integ
+    obs = observer_report()
+    if obs.get("components"):
+        snap["observer"] = obs
     return snap
 
 
@@ -397,6 +409,31 @@ def report(file=None) -> None:
             print(f"  sentinel baselines={sen['baselines']}"
                   f" regressions={sen['regressions']}"
                   f" factor={sen['drift_factor']:g}", file=file)
+        samp = attr.get("sampling")
+        if samp:
+            fenced = sum(len(d.get("fenced_seqs", []))
+                         for d in samp.get("fingerprints", {}).values())
+            calls = sum(d.get("calls", 0)
+                        for d in samp.get("fingerprints", {}).values())
+            print(f"  sampling 1-in-{samp['sample_every']}"
+                  f" fenced={fenced}/{calls} calls", file=file)
+    obs = observer_report()
+    if obs.get("components"):
+        print("-- observer tax --", file=file)
+        comps = " ".join(f"{k}={v['seconds']:.4f}s"
+                         for k, v in obs["components"].items())
+        frac = obs.get("tax_frac")
+        frac_s = f" tax_frac={frac:.2%}" if frac is not None else ""
+        print(f"  total={obs['total_s']:.4f}s{frac_s} {comps}", file=file)
+    # incident explainer verdicts from the recent-event ring: the "why"
+    # an operator should read before opening the flight record by hand
+    whys = [e for e in _events.snapshot_ring() if e.get("why")]
+    if whys:
+        print("-- incident explainer --", file=file)
+        for e in whys[-8:]:
+            label = e.get("label") or e.get("tenant") or ""
+            print(f"  {e.get('type', '?'):<16s} {label:<18s}"
+                  f" {e['why']}", file=file)
     memo = memo_report()
     if memo["enabled"] or memo["inserts"] or memo["hits"]:
         print("-- result memo --", file=file)

@@ -24,6 +24,19 @@ Environment variables:
   ``RAMBA_PERF_WINDOW`` — slow-flush sentinel tuning (see ``ledger``).
 * ``RAMBA_ATTRIB=off`` — disable the always-on ``block_until_ready``
   device fence the stage waterfalls and rooflines use (``attrib``).
+* ``RAMBA_ATTRIB=sample:<N>`` — fence only 1-in-N flushes per kernel
+  fingerprint (deterministic: the fingerprint's flush sequence number,
+  never RNG, so SPMD ranks fence in lockstep); unfenced flushes carry
+  ``device_source:"estimated"`` from the rolling fenced p50, rooflines
+  and sentinels consume fenced samples only.
+* ``RAMBA_TRACE_SAMPLE=<N>`` — head-sample the JSONL trace file to
+  1-in-N trace chains (the in-memory ring stays full-fidelity); chains
+  that end in an incident (slow_flush, flush_error, shed, degrade,
+  stall, integrity, slo_breach, perf_regression) retroactively flush
+  their buffered span chain — the tail latch (``events``).
+* ``RAMBA_TRACE_BUFFER=<n>`` — pending-line bound of the buffered trace
+  writer (default 2048); overflow drops lines and counts
+  ``events.write_dropped`` instead of blocking the flush path.
 * ``RAMBA_PROFILE=deep`` — flush TraceAnnotations carry the span's
   trace id, joining profiler timelines to RAMBA_TRACE spans.
 * ``RAMBA_PEAKS_JSON`` — hardware-peak table override (inline JSON or a
@@ -38,9 +51,14 @@ Environment variables:
   healthy/degraded/stale/dead (``RAMBA_FLEET_STALE_X`` /
   ``RAMBA_FLEET_DEAD_X`` x interval age thresholds, defaults 1.5 / 2.0).
 
+Every observability code path self-accounts its own wall time in
+``observer`` (the observer-tax ledger): exported as
+``ramba_observer_seconds_total{component}`` and gated in bench/perf_diff
+as ``observer_tax_frac`` (< 2 % of flush wall at ``sample:16``).
+
 Public read API lives in ``ramba_tpu.diagnostics`` (``perf_report()`` for
 the ledger, including the ``attribution`` section); the fleet-level read
 API is ``ramba_tpu.observe.fleet`` (``health()`` / ``rollup()``).
 """
 
-from ramba_tpu.observe import attrib, events, fleet, health, ledger, profile, registry  # noqa: F401
+from ramba_tpu.observe import attrib, events, fleet, health, ledger, observer, profile, registry  # noqa: F401

@@ -9,10 +9,29 @@ take"; this module answers the two questions the ledger cannot:
   dispatch / device_execute / write_back`` — and :func:`finalize_span`
   folds the residual into ``unattributed_s`` so the stage durations plus
   the residual always reconcile with ``wall_s``.  Device time comes from
-  an always-on ``jax.block_until_ready`` fence after each compiled call
-  (opt out with ``RAMBA_ATTRIB=off``); under ``RAMBA_PROFILE=deep`` the
-  same spans are joined to XLA profiler traces via
+  a ``jax.block_until_ready`` fence after each compiled call (opt out
+  with ``RAMBA_ATTRIB=off``); under ``RAMBA_PROFILE=deep`` the same
+  spans are joined to XLA profiler traces via
   ``jax.profiler.TraceAnnotation`` carrying the span's trace id.
+
+  ``RAMBA_ATTRIB=sample:<N>`` fences 1-in-N calls **per kernel
+  fingerprint** instead of every call: the decision is the
+  fingerprint's own flush-sequence counter modulo N — pure arithmetic,
+  never RNG — so SPMD ranks replaying the same program order fence the
+  SAME sequence numbers in lockstep and a coherence epoch can never
+  pair a fenced rank with an unfenced one.  Unfenced flushes carry
+  ``device_source: "estimated"`` with a ``device_est_s`` taken from the
+  fingerprint's rolling *fenced* p50 (never stamped into ``stages`` —
+  the device tail genuinely overlaps the host after an unfenced
+  dispatch); rooflines and the drift sentinel consume fenced samples
+  only, so classifications under sampling match always-on.
+
+* **Why was THIS flush slow?**  :func:`finalize_span` also maintains
+  per-fingerprint per-stage rolling baselines; when an incident fires
+  (``slow_flush``, ``perf_regression``, ``slo_breach``) the sentinel
+  calls :func:`explain` to diff the span's waterfall against those
+  baselines and stamp a ``why`` verdict naming the dominant divergent
+  stage ("queue_wait 12.0x baseline -> overload").
 
 * **How close does a kernel run to the silicon's peak?**  The ledger's
   ``cost_analysis`` flops/bytes are combined with the fenced device-time
@@ -69,6 +88,7 @@ _lock = threading.Lock()
 
 # config (reread by reconfigure())
 _enabled = True
+_sample_n = 1  # fence 1-in-N calls per fingerprint (1 = always)
 _drift_factor = 2.0
 _drift_min_samples = 5
 _baseline_dir: Optional[str] = None
@@ -80,6 +100,14 @@ _unattributed_total = 0.0
 _flushes = 0
 # fp -> {"label", "win": _Rolling, "backends": {name: _Rolling}}
 _device: "dict[str, dict]" = {}
+# sampled-fence bookkeeping: fp -> next flush-sequence number, and the
+# (bounded) list of sequence numbers that were fenced — the lockstep
+# proof two_process_suite --sampling-leg compares across ranks
+_flush_seq: "dict[str, int]" = {}
+_fence_log: "dict[str, list]" = {}
+_FENCE_LOG_MAX = 64
+# incident-explainer baselines: fp -> {stage|"unattributed": _Rolling}
+_stage_base: "dict[str, dict]" = {}
 _baselines: "dict[str, dict]" = {}
 _baselines_loaded = False
 _regressed: "set[str]" = set()
@@ -103,17 +131,27 @@ _BUILTIN_PEAKS = {
 
 
 def reconfigure(*, enabled: Optional[bool] = None,
+                sample_every: Optional[int] = None,
                 drift_factor: Optional[float] = None,
                 drift_min_samples: Optional[int] = None,
                 baseline_dir: Optional[str] = None) -> None:
     """(Re)read env config; kwargs override env (tests)."""
-    global _enabled, _drift_factor, _drift_min_samples, _baseline_dir
-    global _peaks_override, _baselines_loaded
+    global _enabled, _sample_n, _drift_factor, _drift_min_samples
+    global _baseline_dir, _peaks_override, _baselines_loaded
+    raw = os.environ.get("RAMBA_ATTRIB", "1").strip().lower()
     if enabled is None:
-        _enabled = os.environ.get(
-            "RAMBA_ATTRIB", "1").lower() not in ("0", "off", "false", "no")
+        _enabled = raw not in ("0", "off", "false", "no")
     else:
         _enabled = bool(enabled)
+    if sample_every is None:
+        _sample_n = 1
+        if raw.startswith("sample:"):
+            try:
+                _sample_n = max(1, int(raw.split(":", 1)[1]))
+            except ValueError:
+                _sample_n = 1
+    else:
+        _sample_n = max(1, int(sample_every))
     if drift_factor is None:
         try:
             _drift_factor = float(
@@ -154,8 +192,86 @@ def _load_peaks_override() -> Optional[dict]:
 
 
 def fence_enabled() -> bool:
-    """Is the always-on block_until_ready device fence armed?"""
+    """Is the block_until_ready device fence armed at all?  True under
+    both always-on and ``sample:<N>`` — the per-call verdict is
+    :func:`fence_decision`."""
     return _enabled
+
+
+def sample_every() -> int:
+    """The configured 1-in-N fence sampling period (1 = every call)."""
+    return _sample_n
+
+
+def sampling() -> bool:
+    """Is sampled attribution (``RAMBA_ATTRIB=sample:<N>``) active?"""
+    return _enabled and _sample_n > 1
+
+
+def fence_decision(fp: Optional[str], span: Optional[dict] = None) -> bool:
+    """Should THIS compiled call fence?  Always True outside sampling
+    mode.  Under ``sample:<N>`` the verdict is ``seq % N == 0`` where
+    ``seq`` is the fingerprint's own monotone call counter — a pure
+    function of program order, so SPMD ranks that replay the same flush
+    sequence fence the same calls without any cross-rank agreement (and
+    a rank-skewed timing fault cannot desync them).  Stamps the span's
+    ``device_source`` ("fenced"/"estimated"); a segmented flush with
+    any fenced segment reads as fenced."""
+    if not _enabled:
+        return False
+    if _sample_n <= 1:
+        return True
+    key = fp or ""
+    with _lock:
+        seq = _flush_seq.get(key, 0)
+        _flush_seq[key] = seq + 1
+        fenced = (seq % _sample_n == 0)
+        if fenced:
+            log = _fence_log.setdefault(key, [])
+            if len(log) < _FENCE_LOG_MAX:
+                log.append(seq)
+    if span is not None:
+        span["fence_seq"] = seq
+        if fenced:
+            span["device_source"] = "fenced"
+        else:
+            span.setdefault("device_source", "estimated")
+    return fenced
+
+
+def estimated_device_s(fp: Optional[str]) -> Optional[float]:
+    """Rolling p50 of this fingerprint's *fenced* device windows — the
+    stand-in device time an unfenced flush carries (``device_est_s``).
+    None until at least one fenced sample exists."""
+    if not fp:
+        return None
+    with _lock:
+        ent = _device.get(fp)
+        if ent is None:
+            return None
+        return ent["win"].quantile(0.50)
+
+
+def sampling_report() -> dict:
+    """Per-fingerprint fence decisions under sampling: call counts and
+    the fenced sequence numbers (lockstep proof for the SPMD suite)."""
+    with _lock:
+        return {
+            "enabled": _enabled,
+            "sample_every": _sample_n,
+            "fingerprints": {
+                fp: {"calls": _flush_seq.get(fp, 0),
+                     "fenced_seqs": list(_fence_log.get(fp, []))}
+                for fp in sorted(_flush_seq)
+            },
+        }
+
+
+def flush_wall_total() -> float:
+    """Total attributed flush wall (stages + residual) — the observer
+    tax's denominator (observe/observer.py)."""
+    with _lock:
+        return sum(_stage_totals.values()) + _unattributed_total
 
 
 # ---------------------------------------------------------------------------
@@ -173,7 +289,8 @@ def add_stage(span: Optional[dict], stage: str, seconds: float) -> None:
 
 def finalize_span(span: dict, fp: Optional[str] = None) -> None:
     """Round the span's stage ledger, fold the residual into
-    ``unattributed_s``, and roll both into the global/per-fp totals.
+    ``unattributed_s``, and roll both into the global/per-fp totals
+    (including the incident explainer's per-stage baselines).
     Called once per flush just before the span event is emitted."""
     st = span.get("stages")
     if st is None:
@@ -192,6 +309,19 @@ def finalize_span(span: dict, fp: Optional[str] = None) -> None:
         _unattributed_total += un
         for k, v in st.items():
             _stage_totals[k] = _stage_totals.get(k, 0.0) + v
+        if fp:
+            base = _stage_base.get(fp)
+            if base is None:
+                base = _stage_base[fp] = {}
+            for k, v in st.items():
+                win = base.get(k)
+                if win is None:
+                    win = base[k] = _ledger._Rolling()
+                win.add(v)
+            uwin = base.get("unattributed")
+            if uwin is None:
+                uwin = base["unattributed"] = _ledger._Rolling()
+            uwin.add(un)
 
 
 def _ordered(stages: dict) -> dict:
@@ -199,6 +329,93 @@ def _ordered(stages: dict) -> dict:
     for k in stages:  # future stages survive the reorder
         out.setdefault(k, stages[k])
     return out
+
+
+# ---------------------------------------------------------------------------
+# incident explainer
+# ---------------------------------------------------------------------------
+
+# dominant divergent stage -> operator-facing verdict
+_EXPLAIN_VERDICTS = {
+    "queue_wait": "overload",
+    "coalesce": "overload",
+    "compile": "cache miss",
+    "admit": "memory pressure",
+    "device_execute": "device regression",
+    "dispatch": "host dispatch slowdown",
+    "write_back": "host dispatch slowdown",
+    "trace": "host analysis slowdown",
+    "prepare": "host analysis slowdown",
+    "verify": "host analysis slowdown",
+    "unattributed": "untracked interference (GC / lock convoy?)",
+}
+_EXPLAIN_MIN_SAMPLES = 3   # baseline window floor before a ratio is trusted
+_EXPLAIN_FACTOR = 1.5      # a stage must exceed 1.5x its p50 to diverge
+_EXPLAIN_NOVEL_FRAC = 0.25  # baseline-less stage must eat >=25% of wall
+
+
+def explain(span: dict, fp: Optional[str] = None) -> Optional[dict]:
+    """Diff one span's stage waterfall against its fingerprint's rolling
+    per-stage baselines and name the dominant divergent stage.
+
+    Returns ``{"stage", "verdict", "text", "ratio", "stage_s",
+    "baseline_p50_s"}`` or None when nothing diverges (or no baseline
+    history exists yet).  Dominance is by absolute excess over the
+    baseline p50 — the stage that actually ate the wall, not the one
+    with the flashiest ratio on a microsecond base.  A stage with no
+    baseline at all (e.g. ``compile`` appearing on a steady-state
+    fingerprint) is divergent by existence when it claims a meaningful
+    share of the wall — that IS the cache-miss signature."""
+    if fp is None:
+        fp = span.get("fingerprint")
+    st = dict(span.get("stages") or {})
+    un = span.get("unattributed_s")
+    if isinstance(un, (int, float)) and un > 0:
+        st["unattributed"] = float(un)
+    if not fp or not st:
+        return None
+    wall = float(span.get("wall_s") or 0.0)
+    best = None  # (excess, stage, baseline_p50, value)
+    with _lock:
+        base = _stage_base.get(fp)
+        if not base:
+            return None
+        for k, v in st.items():
+            if not isinstance(v, (int, float)) or v <= 0:
+                continue
+            win = base.get(k)
+            p50 = (win.quantile(0.50)
+                   if win is not None and win.count >= _EXPLAIN_MIN_SAMPLES
+                   else None)
+            if p50 is None or p50 <= 0:
+                if wall > 0 and v >= _EXPLAIN_NOVEL_FRAC * wall:
+                    cand = (float(v), k, None, float(v))
+                else:
+                    continue
+            else:
+                if v <= p50 * _EXPLAIN_FACTOR:
+                    continue
+                cand = (float(v) - p50, k, p50, float(v))
+            if best is None or cand[0] > best[0]:
+                best = cand
+    if best is None:
+        return None
+    _excess, stage, p50, value = best
+    verdict = _EXPLAIN_VERDICTS.get(stage, "stage regression")
+    if p50:
+        ratio = value / p50
+        text = f"{stage} {ratio:.1f}x baseline -> {verdict}"
+    else:
+        ratio = None
+        text = f"{stage} -> {verdict}"
+    return {
+        "stage": stage,
+        "verdict": verdict,
+        "text": text,
+        "ratio": round(ratio, 2) if ratio is not None else None,
+        "stage_s": round(value, 6),
+        "baseline_p50_s": round(p50, 6) if p50 else None,
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -253,17 +470,22 @@ def _check_drift_locked(fp: str, ent: dict) -> Optional[dict]:
     _regressed.add(fp)
     _regressions += 1
     _registry.inc("attrib.perf_regression")
+    drift = round(p50 / base_p50, 3)
     return {
         "type": "perf_regression",
         "fingerprint": fp,
         "label": ent["label"],
         "p50_s": round(p50, 6),
         "baseline_p50_s": round(base_p50, 6),
-        "drift": round(p50 / base_p50, 3),
+        "drift": drift,
         "factor": _drift_factor,
         "samples": win.count,
         "baseline_device_kind": base.get("device_kind"),
         "device_kind": device_kind(),
+        # the sentinel compares fenced device windows, so the dominant
+        # divergent stage is device_execute by construction
+        "why": f"device_execute {drift:.1f}x baseline -> device regression",
+        "why_stage": "device_execute",
     }
 
 
@@ -539,6 +761,8 @@ def attribution_report() -> dict:
     attributed = sum(stage_totals.values())
     denom = attributed + un
     out["unattributed_frac"] = round(un / denom, 4) if denom > 0 else 0.0
+    if sampling():
+        out["sampling"] = sampling_report()
     return out
 
 
@@ -554,6 +778,9 @@ def reset() -> None:
         _unattributed_total = 0.0
         _flushes = 0
         _device.clear()
+        _flush_seq.clear()
+        _fence_log.clear()
+        _stage_base.clear()
         _baselines.clear()
         _baselines_loaded = False
         _regressed.clear()

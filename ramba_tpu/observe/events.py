@@ -14,6 +14,28 @@ immune to NTP steps, the clock cross-rank skew alignment and heartbeat-gap
 math trust), ``seq`` (per-process monotone), and ``rank`` (multi-controller
 only).
 
+The file write happens OUTSIDE ``_emit_lock``: emit serializes the line
+under the lock (seq order == file order) but only appends it to a bounded
+pending buffer; a separate writer lock drains the buffer with a
+non-blocking combining pattern, so a slow disk stalls at most the one
+emitter that happens to be draining — never every emitter.  Overflow and
+write failures are counted (``events.write_dropped`` /
+``events.write_errors``), never raised.  ``sync()`` (called from
+``fuser.sync``) and ``close()`` drain blocking; incident events drain
+blocking too so a flight recorder never races its own evidence to disk.
+
+**Tail-based retention** (``RAMBA_TRACE_SAMPLE=<N>``): the ring stays
+full-fidelity, but the file lane head-samples 1-in-N *traces* — the
+verdict is a deterministic hash of the ``trace_id`` (identical on every
+rank), so a sampled-out trace is sampled out everywhere.  Sampled-out
+events park in a bounded per-trace buffer; if the chain later hits an
+incident (``TAIL_TRIGGERS``: slow_flush / flush_error / shed / degrade /
+stall / integrity / slo_breach / perf_regression) the buffer is
+retroactively flushed and the trace latched in — incidents are always
+fully traced, steady-state traffic costs 1/N the bytes.  A rotated
+buffer leaves a ``trace_gap`` marker so trace_report can tell a
+sampling gap from a genuine orphan.
+
 Two injection points keep this module import-light while letting the
 telemetry plane (observe/telemetry.py) see every event:
 
@@ -29,16 +51,20 @@ from __future__ import annotations
 
 import atexit
 import collections
+import hashlib
 import json
 import os
 import threading
 import time
 from typing import Optional
 
-# Serializes seq assignment, the ring append, and the file write so events
-# from concurrent serving streams interleave as whole lines with strictly
-# increasing seq (deque.append alone is atomic, but seq would race and the
-# JSONL file would tear).
+from ramba_tpu.observe import observer as _observer
+from ramba_tpu.observe import registry as _registry
+
+# Serializes seq assignment, the ring append, and the pending-buffer
+# append so events from concurrent serving streams land as whole lines
+# with strictly increasing seq (deque.append alone is atomic, but seq
+# would race and the JSONL file would tear).
 _emit_lock = threading.Lock()
 
 _RING_MAX = max(1, int(os.environ.get("RAMBA_TRACE_RING", "256") or 256))
@@ -54,6 +80,32 @@ _rank: Optional[tuple] = None
 # telemetry injection points (see module docstring)
 _context_provider = None
 _taps: list = []
+
+
+def _env_int(name: str, default: int, floor: int = 1) -> int:
+    try:
+        return max(floor, int(os.environ.get(name, str(default)) or default))
+    except ValueError:
+        return default
+
+
+# -- buffered file writer (drained outside _emit_lock) ----------------------
+_write_lock = threading.Lock()
+_pending: list = []  # serialized lines awaiting the writer, emit-lock guarded
+_PENDING_MAX = _env_int("RAMBA_TRACE_BUFFER", 2048)
+
+# -- tail-based retention ----------------------------------------------------
+# Incident types that latch a sampled-out trace into the file lane.
+TAIL_TRIGGERS = ("slow_flush", "flush_error", "shed", "degrade", "stall",
+                 "integrity", "slo_breach", "perf_regression")
+_trace_sample = _env_int("RAMBA_TRACE_SAMPLE", 1)
+_TAIL_SPANS = 64        # buffered events per sampled-out trace
+_TAIL_TRACES_MAX = 256  # distinct sampled-out traces buffered at once
+# trace_id -> [deque(lines, maxlen=_TAIL_SPANS), rotated_count]; LRU by
+# insertion so a trace flood evicts the oldest chain wholesale
+_tail_buffers: "collections.OrderedDict" = collections.OrderedDict()
+_tail_latched: set = set()
+_sample_memo: dict = {}  # trace_id -> head-sampling verdict (bounded)
 
 
 def set_context_provider(fn) -> None:
@@ -82,12 +134,49 @@ def trace_enabled() -> bool:
     return _trace_path is not None
 
 
-def configure(path: Optional[str]) -> None:
+def configure(path: Optional[str], *,
+              sample: Optional[int] = None,
+              buffer_max: Optional[int] = None) -> None:
     """(Re)point the JSONL sink — primarily for tests; production use is
-    the RAMBA_TRACE environment variable read at import."""
-    global _trace_path
-    close()
+    the RAMBA_TRACE environment variable read at import.  Rereads
+    ``RAMBA_TRACE_SAMPLE`` / ``RAMBA_TRACE_BUFFER`` (kwargs override)
+    and resets the tail-retention state: a new sink starts with no
+    latched traces and an empty per-trace buffer."""
+    global _trace_path, _trace_sample, _PENDING_MAX
+    close()  # drains pending lines to the OLD sink first
     _trace_path = path or None
+    _trace_sample = (max(1, int(sample)) if sample is not None
+                     else _env_int("RAMBA_TRACE_SAMPLE", 1))
+    if buffer_max is not None:
+        _PENDING_MAX = max(1, int(buffer_max))
+    else:
+        _PENDING_MAX = _env_int("RAMBA_TRACE_BUFFER", 2048)
+    with _emit_lock:
+        _tail_buffers.clear()
+        _tail_latched.clear()
+        _sample_memo.clear()
+
+
+def trace_sample_every() -> int:
+    """The configured 1-in-N head-sampling period for the file lane."""
+    return _trace_sample
+
+
+def trace_sampled_in(trace_id) -> bool:
+    """Deterministic head-sampling verdict for one trace id: a hash of
+    the id modulo N — identical on every rank, so a trace is sampled in
+    (or out) fleet-wide.  Events without a trace id are always in."""
+    if _trace_sample <= 1 or trace_id is None:
+        return True
+    v = _sample_memo.get(trace_id)
+    if v is None:
+        h = int.from_bytes(
+            hashlib.sha256(str(trace_id).encode()).digest()[:4], "big")
+        v = (h % _trace_sample == 0)
+        if len(_sample_memo) >= 4096:
+            _sample_memo.clear()
+        _sample_memo[trace_id] = v
+    return v
 
 
 def _probe_rank():
@@ -161,11 +250,70 @@ def _file():
     return _trace_file
 
 
+def _append_pending_locked(line: str) -> None:
+    """Queue one serialized line for the writer (emit lock held).  A
+    full buffer drops the line and counts it — never blocks, never
+    raises (the writer being slow must not become backpressure on the
+    computation)."""
+    if len(_pending) >= _PENDING_MAX:
+        _registry.inc("events.write_dropped")
+        return
+    _pending.append(line)
+
+
+def _enqueue_locked(event: dict, line: str) -> bool:
+    """Route one serialized event into the file lane (emit lock held):
+    straight to the pending buffer, or into the trace's tail buffer
+    when its trace is head-sampled out.  Returns True when the event is
+    an incident (the caller drains blocking so the latched chain — and
+    the incident itself — are on disk before taps run)."""
+    incident = event.get("type") in TAIL_TRIGGERS
+    tid = event.get("trace_id")
+    if _trace_sample > 1 and tid is not None and tid not in _tail_latched:
+        if incident:
+            # tail latch: this boring trace just became evidence —
+            # replay its buffered chain ahead of the incident line and
+            # keep every later event of the trace
+            _tail_latched.add(tid)
+            if len(_tail_latched) > 8192:  # leak bound; re-latch on demand
+                _tail_latched.clear()
+                _tail_latched.add(tid)
+            ent = _tail_buffers.pop(tid, None)
+            if ent is not None:
+                buf, rotated = ent
+                if rotated:
+                    gap = {"type": "trace_gap", "trace_id": tid,
+                           "dropped": rotated,
+                           "reason": "tail_buffer_rotation"}
+                    _append_pending_locked(
+                        json.dumps(gap, default=str) + "\n")
+                for buffered in buf:
+                    _append_pending_locked(buffered)
+            _registry.inc("events.tail_latched")
+        elif not trace_sampled_in(tid):
+            ent = _tail_buffers.get(tid)
+            if ent is None:
+                if len(_tail_buffers) >= _TAIL_TRACES_MAX:
+                    _tail_buffers.popitem(last=False)
+                ent = _tail_buffers[tid] = [
+                    collections.deque(maxlen=_TAIL_SPANS), 0]
+            buf = ent[0]
+            if len(buf) == buf.maxlen:
+                ent[1] += 1
+            buf.append(line)
+            _registry.inc("events.tail_buffered")
+            return incident
+    _append_pending_locked(line)
+    return incident
+
+
 def emit(event: dict) -> dict:
     """Stamp and record one event.  Mutates ``event`` in place (adds
     ts/seq/rank) and returns it.  Never raises out of the sink: a full
     disk must not take the computation down with it."""
     global _seq
+    t_obs = time.perf_counter()
+    incident = False
     with _emit_lock:
         _seq += 1
         event.setdefault("ts", round(time.time(), 6))
@@ -182,18 +330,61 @@ def emit(event: dict) -> dict:
         rank, nprocs = _rank_info() if _trace_path is not None else (None, 1)
         if nprocs > 1:
             event["rank"] = rank
+        if len(ring) == ring.maxlen:
+            _registry.inc("events.ring_dropped")
         ring.append(event)
         if _trace_path is not None:
             try:
-                _file().write(json.dumps(event, default=str) + "\n")
-            except OSError:
-                pass
+                incident = _enqueue_locked(
+                    event, json.dumps(event, default=str) + "\n")
+            except Exception:
+                _registry.inc("events.write_errors")
+    if _trace_path is not None:
+        _drain(block=incident)
+    _observer.add("events", time.perf_counter() - t_obs)
     for fn in list(_taps):
         try:
             fn(event)
         except Exception:
             pass
     return event
+
+
+def _drain(block: bool = False) -> None:
+    """Write pending lines to the sink.  Non-blocking by default — if
+    another emitter holds the writer lock our lines ride its drain loop
+    (combining), so a slow disk stalls one thread, not all of them.
+    Failures are counted, never raised."""
+    if not _pending:
+        return
+    if not _write_lock.acquire(blocking=block):
+        return
+    try:
+        while True:
+            with _emit_lock:
+                if not _pending:
+                    break
+                batch = _pending[:]
+                del _pending[:]
+            try:
+                f = _file()
+            except OSError:
+                f = None
+            if f is None:
+                _registry.inc("events.write_dropped", len(batch))
+                continue
+            try:
+                f.write("".join(batch))
+            except (OSError, ValueError):
+                _registry.inc("events.write_errors")
+    finally:
+        _write_lock.release()
+
+
+def sync() -> None:
+    """Block until every pending line is on disk (``fuser.sync`` and the
+    drain-to-checkpoint path call this; tests too)."""
+    _drain(block=True)
 
 
 def snapshot_ring() -> list:
@@ -215,12 +406,17 @@ def last(n: int = 10, type=None) -> list:
 
 def close() -> None:
     global _trace_file
-    if _trace_file is not None:
-        try:
-            _trace_file.close()
-        except OSError:
-            pass
-        _trace_file = None
+    try:
+        _drain(block=True)  # pending lines belong to the sink being closed
+    except Exception:
+        pass
+    with _write_lock:
+        if _trace_file is not None:
+            try:
+                _trace_file.close()
+            except OSError:
+                pass
+            _trace_file = None
 
 
 atexit.register(close)

@@ -70,6 +70,7 @@ import threading
 import time
 from typing import Optional
 
+from ramba_tpu.observe import observer as _observer
 from ramba_tpu.observe import registry as _registry
 from ramba_tpu.observe import slo as _slo
 
@@ -172,6 +173,7 @@ def publish(directory: Optional[str] = None) -> Optional[str]:
     publish_ms = round((time.perf_counter() - t0) * 1e3, 3)
     _registry.inc("fleet.publishes")
     _registry.gauge("fleet.last_publish_ms", publish_ms)
+    _observer.add("fleet", time.perf_counter() - t0)
     return path
 
 

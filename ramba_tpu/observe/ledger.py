@@ -53,11 +53,13 @@ from __future__ import annotations
 import hashlib
 import os
 import threading
+import time as _time
 from collections import deque
 from contextlib import contextmanager
 from typing import Optional
 
 from ramba_tpu.observe import events as _events
+from ramba_tpu.observe import observer as _observer
 from ramba_tpu.observe import registry as _registry
 
 # Guards every mutable store below (_kernels, _flush_walls, _fp_memo, the
@@ -464,6 +466,7 @@ def record_execute(fp: str, label: str, instrs: int, rung: str,
     ``core/autotune.py`` races on.  Compiles inherit the ambient
     :func:`compile_source` scope ("warm" inside warm-pool thunks)."""
     src = current_compile_source() if is_new else None
+    t_obs = _time.perf_counter()
     with _lock:
         e = _entry(fp, label, instrs, donated)
         e.instrs = instrs or e.instrs
@@ -492,6 +495,7 @@ def record_execute(fp: str, label: str, instrs: int, rung: str,
                 b.compile_s += seconds
             else:
                 b.exec.add(seconds)
+    _observer.add("ledger", _time.perf_counter() - t_obs)
     if is_new and _events.trace_enabled():
         _events.emit({
             "type": "compile",
@@ -594,6 +598,7 @@ def observe_flush(span: dict) -> Optional[dict]:
     global _slow_flushes
     label = span.get("label", "?")
     wall = float(span.get("wall_s", 0.0) or 0.0)
+    t_obs = _time.perf_counter()
     with _lock:
         win = _flush_walls.get(label)
         if win is None:
@@ -610,6 +615,7 @@ def observe_flush(span: dict) -> Optional[dict]:
         if rwin is None:
             rwin = _rung_walls[rkey] = _Rolling()
         rwin.add(wall)
+    _observer.add("ledger", _time.perf_counter() - t_obs)
     fired = None
     if fire_p50 is not None:
         p50, samples = fire_p50
@@ -634,6 +640,25 @@ def observe_flush(span: dict) -> Optional[dict]:
         # blew past its program's history
         if span.get("tenant") is not None:
             ev["tenant"] = span["tenant"]
+        # trace join: carry the flush's trace id so the tail-retention
+        # latch (observe/events.py) keys on the incident's own chain even
+        # when the sentinel runs outside the dispatch span scope
+        if span.get("trace_id") is not None:
+            ev["trace_id"] = span["trace_id"]
+        # incident explainer: diff this flush's waterfall against its
+        # fingerprint's rolling per-stage baselines and name the
+        # dominant divergent stage.  Lazy import — attrib imports this
+        # module at the top level.
+        try:
+            from ramba_tpu.observe import attrib as _attrib
+
+            why = _attrib.explain(span)
+            if why is not None:
+                ev["why"] = why["text"]
+                ev["why_stage"] = why["stage"]
+                ev["why_verdict"] = why["verdict"]
+        except Exception:
+            pass
         fired = _events.emit(ev)
     return fired
 

@@ -189,10 +189,13 @@ def observe_span(span: dict) -> None:
 
 
 def observe_e2e(seconds: float, tenant: Optional[str] = None,
-                trace_id: Optional[str] = None) -> Optional[dict]:
+                trace_id: Optional[str] = None,
+                span: Optional[dict] = None) -> Optional[dict]:
     """Record one end-to-end ticket latency and evaluate the SLO.
     Returns the ``slo_breach`` event if this observation crossed the
-    objective (None otherwise)."""
+    objective (None otherwise).  ``span`` (the flush that tipped the
+    p95) lets the incident explainer stamp a ``why`` verdict naming the
+    dominant divergent stage."""
     fire = None
     with _lock:
         h = _hist("e2e", tenant)
@@ -225,6 +228,19 @@ def observe_e2e(seconds: float, tenant: Optional[str] = None,
         ev["tenant"] = tenant
     if trace_id is not None:
         ev["trace_id"] = trace_id
+    if span is not None:
+        # incident explainer: why was the flush that tipped the p95
+        # slow?  Lazy import — observe modules must stay a DAG.
+        try:
+            from ramba_tpu.observe import attrib as _attrib
+
+            why = _attrib.explain(span)
+            if why is not None:
+                ev["why"] = why["text"]
+                ev["why_stage"] = why["stage"]
+                ev["why_verdict"] = why["verdict"]
+        except Exception:
+            pass
     return _events.emit(ev)
 
 

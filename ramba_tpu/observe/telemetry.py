@@ -56,6 +56,7 @@ from typing import Optional
 
 from ramba_tpu.observe import events as _events
 from ramba_tpu.observe import ledger as _ledger
+from ramba_tpu.observe import observer as _observer
 from ramba_tpu.observe import registry as _registry
 from ramba_tpu.observe import slo as _slo
 
@@ -198,6 +199,7 @@ def dump_flight(incident: dict, directory: Optional[str] = None) -> Optional[str
     if d is None:
         return None
     global _flight_dumps
+    t_obs = time.perf_counter()
     with _flight_lock:
         _flight_dumps += 1
         n = _flight_dumps
@@ -225,6 +227,7 @@ def dump_flight(incident: dict, directory: Optional[str] = None) -> Optional[str
     _registry.inc("telemetry.flight_dumps")
     with _flight_lock:
         _gc_flight(d)
+    _observer.add("flight", time.perf_counter() - t_obs)
     return path
 
 
@@ -500,6 +503,22 @@ def _attrib_series(fams: _Families) -> None:
                  row.get("achieved_tflops", 0.0), labels)
 
 
+def _observer_series(fams: _Families) -> None:
+    """The observability plane's own bill (observe/observer.py): wall
+    seconds per component plus the tax as a fraction of attributed
+    flush wall — the number perf_diff gates below 2%."""
+    snap = _observer.snapshot()
+    comps = snap.get("components") or {}
+    if not comps:
+        return  # plane has not billed anything yet: stay quiet
+    for name, ent in sorted(comps.items()):
+        fams.add("ramba_observer_seconds_total", "counter",
+                 ent.get("seconds", 0.0), {"component": name})
+    frac = snap.get("tax_frac")
+    if frac is not None:
+        fams.add("ramba_observer_tax_frac", "gauge", frac)
+
+
 def _elastic_series(fams: _Families) -> None:
     from ramba_tpu.resilience import elastic as _elastic
 
@@ -537,39 +556,47 @@ def render() -> str:
     """The full Prometheus exposition.  Each source is snapshotted under
     its own lock (internally consistent per subsystem); a scrape is one
     moment per subsystem, not one global stop-the-world."""
-    rank, _nprocs = _events._rank_info()
-    fams = _Families({"rank": rank})
+    t_obs = time.perf_counter()
     try:
-        _process_info_series(fams)
-    except Exception:
-        pass  # identity must never break a scrape
-    snap = _registry.snapshot()
-    _counter_series(fams, snap, _registry.gauge_names())
-    _ledger_series(fams)
-    try:
-        _memory_series(fams)
-    except Exception:
-        pass  # governor not imported/available: skip its families
-    _slo_series(fams)
-    try:
-        _autotune_series(fams)
-    except Exception:
-        pass  # autotuner not imported/available: skip its families
-    try:
-        _compile_series(fams)
-    except Exception:
-        pass  # compile classes / persist cache unused: skip
-    try:
-        _attrib_series(fams)
-    except Exception:
-        pass  # attribution plane unused: skip
-    try:
-        _elastic_series(fams)
-    except Exception:
-        pass
-    fams.add("ramba_scrape_timestamp_seconds", "gauge",
-             round(time.time(), 3))
-    return fams.render()
+        rank, _nprocs = _events._rank_info()
+        fams = _Families({"rank": rank})
+        try:
+            _process_info_series(fams)
+        except Exception:
+            pass  # identity must never break a scrape
+        snap = _registry.snapshot()
+        _counter_series(fams, snap, _registry.gauge_names())
+        _ledger_series(fams)
+        try:
+            _memory_series(fams)
+        except Exception:
+            pass  # governor not imported/available: skip its families
+        _slo_series(fams)
+        try:
+            _autotune_series(fams)
+        except Exception:
+            pass  # autotuner not imported/available: skip its families
+        try:
+            _compile_series(fams)
+        except Exception:
+            pass  # compile classes / persist cache unused: skip
+        try:
+            _attrib_series(fams)
+        except Exception:
+            pass  # attribution plane unused: skip
+        try:
+            _observer_series(fams)
+        except Exception:
+            pass  # observer ledger empty: skip
+        try:
+            _elastic_series(fams)
+        except Exception:
+            pass
+        fams.add("ramba_scrape_timestamp_seconds", "gauge",
+                 round(time.time(), 3))
+        return fams.render()
+    finally:
+        _observer.add("telemetry", time.perf_counter() - t_obs)
 
 
 def textfile_path(path: str) -> str:

@@ -296,9 +296,12 @@ class CompilePipeline:
         # missed its objective.
         work = ticket.work
         if work is not None and work.enqueued_at is not None:
+            # the span rides along so an slo_breach can carry the
+            # explainer's "why" verdict for the flush that tipped it
             _slo.observe_e2e(time.perf_counter() - work.enqueued_at,
                              tenant=ticket.stream.tenant,
-                             trace_id=ticket.trace_id)
+                             trace_id=ticket.trace_id,
+                             span=work.span or None)
         # Feed the tenant's circuit breaker — but never count overload
         # sheds as failures (a shed storm tripping breakers would be a
         # positive feedback loop), warm thunks (no tenant traffic), or

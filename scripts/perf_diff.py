@@ -74,6 +74,8 @@ _METRIC_DIRECTION = {
     "bucket_pad_waste_frac": "lower",   # zero-padding overhead of pow2
     "attrib_unattributed_frac": "lower",  # waterfall residual share
     "roofline_peak_frac": "higher",     # best kernel's fraction of peak
+    "observer_tax_frac": "lower",       # self-metered observability share
+    "trace_bytes_per_flush": "lower",   # full-fidelity JSONL lane cost
     "integrity_overhead_frac": "lower",  # digest stamping share of flush wall
     "audit_overhead_ms": "lower",       # per-shadow-audit recompute cost
     "fsck_scan_ms": "lower",            # offline artifact-tier scan wall

@@ -42,3 +42,5 @@ echo "=== leg 18: fleet serving plane (router + replicas, shared artifact tier, 
 python scripts/two_process_suite.py --router-leg
 echo "=== leg 19: data integrity plane (2-rank agreed audit verdict; RAMBA_INTEGRITY=0 wrong-answer repro) ==="
 python scripts/two_process_suite.py --integrity-leg
+echo "=== leg 20: self-metering observability (sampled attribution lockstep, tail-based trace retention) ==="
+python scripts/two_process_suite.py --sampling-leg

@@ -59,9 +59,16 @@ STAGE_ORDER = ("trace", "prepare", "verify", "queue_wait", "coalesce",
 
 def _stage_sig(flush: dict) -> str:
     """Order-stable stage signature of one flush span ('' when the span
-    predates the stage ledger)."""
+    predates the stage ledger).  A span with ``device_source:
+    "estimated"`` skipped its device fence by SAMPLING POLICY
+    (RAMBA_ATTRIB=sample:<N>), not by behavior — normalize it as if the
+    fence had fired, so estimated-vs-fenced never reads as a rank
+    divergence while a genuinely missing fence still does."""
     st = flush.get("stages") or {}
-    return ",".join(k for k in STAGE_ORDER if k in st)
+    estimated = (flush.get("device_source") == "estimated")
+    return ",".join(
+        k for k in STAGE_ORDER
+        if k in st or (estimated and k == "device_execute"))
 
 
 def _discover(path: str) -> list:
@@ -938,6 +945,16 @@ def attrib_report(path: str, events: list, top: int = 10,
             if h50 > 0:
                 line += f" ({m50 / h50:.1f}x)"
         print(line, file=file)
+    # sampled attribution (RAMBA_ATTRIB=sample:<N>): estimated spans
+    # carry a rolling fenced p50 instead of a measured device window
+    estimated = [e for e in flushes
+                 if e.get("device_source") == "estimated"]
+    if estimated:
+        fenced = sum(1 for e in flushes
+                     if e.get("device_source") == "fenced")
+        print(f"sampled attribution: {fenced} fenced / "
+              f"{len(estimated)} estimated span(s) "
+              "(device_est_s = rolling fenced p50)", file=file)
     recent = flushes[-8:]
     print(f"recent flushes (last {len(recent)}):", file=file)
     for e in recent:
@@ -946,8 +963,23 @@ def attrib_report(path: str, events: list, top: int = 10,
         u = u if isinstance(u, (int, float)) else 0.0
         rung = e.get("degraded", "fused")
         plan = f" plan={e['plan_cache']}" if e.get("plan_cache") else ""
-        print(f"  {e.get('label', '?')} [{rung}]{plan} wall={wall:.4f}s  "
+        dev = ""
+        if e.get("device_source") == "estimated":
+            est = e.get("device_est_s")
+            dev = (f" dev~{est:.4f}s(est)"
+                   if isinstance(est, (int, float))
+                   else " dev=?(est,no fenced history)")
+        print(f"  {e.get('label', '?')} [{rung}]{plan} wall={wall:.4f}s{dev}  "
               + _waterfall(e["stages"], wall, u), file=file)
+    # incident explainer verdicts (stamped by the sentinels — see
+    # observe/attrib.py explain()): why each incident's flush diverged
+    whys = [e for e in events if e.get("why")]
+    if whys:
+        print(f"incident explainer verdicts ({len(whys)}):", file=file)
+        for e in whys[-8:]:
+            who = e.get("label") or e.get("fingerprint") or ""
+            print(f"  {e.get('type', '?'):<16s} {who:<22s} {e['why']}",
+                  file=file)
     gaps = sorted(per_label.items(), key=lambda kv: kv[1]["unattributed"],
                   reverse=True)
     gaps = [(lb, a) for lb, a in gaps if a["unattributed"] > 0][:top]
@@ -1047,21 +1079,39 @@ def trace_chain(trace_id: str, per_rank: dict, file=None) -> int:
     # lives in a process we did not collect — an orphaned half.
     session_level = []
     orphaned = []
+    # trace_gap markers: the tail-latch buffer (RAMBA_TRACE_SAMPLE)
+    # rotated before this trace latched in — events are missing by
+    # sampling policy, not by collection failure
+    gaps = [(r, e) for r, e in evs if e.get("type") == "trace_gap"]
+    gap_dropped = sum(e.get("dropped") or 0 for _, e in gaps)
     for pid, kids in children.items():
         if pid in span_ids:
             continue
         if pid is None or pid in root_ids:
-            session_level.extend(kids)
+            session_level.extend(
+                (cr, c) for cr, c in kids if c.get("type") != "trace_gap")
         else:
-            orphaned.extend((pid, cr, c) for cr, c in kids)
+            orphaned.extend((pid, cr, c) for cr, c in kids
+                            if c.get("type") != "trace_gap")
     if session_level:
         print("session-level events:", file=file)
         for cr, c in sorted(session_level, key=_key):
             print(f"{rel(c)} {_rname(cr)}  {_merge_line(c)}", file=file)
+    if gaps:
+        print(f"sampling gap: {gap_dropped} event(s) dropped by the "
+              "tail-latch buffer before this trace latched in "
+              "(RAMBA_TRACE_SAMPLE head sampling — raise "
+              "RAMBA_TRACE_SAMPLE fidelity or the buffer bound to keep "
+              "longer pre-incident chains)", file=file)
     if orphaned:
-        print(f"ORPHANED events ({len(orphaned)}) — parent span not in "
-              "any collected stream (other half of the trace missing):",
-              file=file)
+        if gaps:
+            print(f"sampled-out events ({len(orphaned)}) — parent span "
+                  "fell out of the tail-latch buffer (see sampling gap "
+                  "above), NOT a missing rank:", file=file)
+        else:
+            print(f"ORPHANED events ({len(orphaned)}) — parent span not "
+                  "in any collected stream (other half of the trace "
+                  "missing):", file=file)
         for pid, cr, c in sorted(orphaned, key=lambda t: _key(t[1:])):
             print(f"{rel(c)} {_rname(cr)}  {_merge_line(c)}"
                   f"  [parent_span={pid}]", file=file)

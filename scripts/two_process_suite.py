@@ -192,6 +192,23 @@ and must hit the AOT lane in LOCKSTEP (equal, nonzero persist-hit
 counts on both ranks).  The runner compares the per-rank class-decision
 tables within and across phases and the persist hit counts across
 ranks.
+
+``--sampling-leg`` runs the self-metering-observability acceptance leg
+(PR 20): two ranks under ``RAMBA_ATTRIB=sample:4`` +
+``RAMBA_TRACE_SAMPLE=4`` with a rank-skewed ``execute:delay`` fault.
+The fence verdict is the fingerprint's flush sequence number (never
+RNG, never timing), so both ranks must fence the IDENTICAL sequence
+numbers per fingerprint and classify every roofline identically even
+while rank 1 runs 40 ms slower per execute — a timing-derived sampler
+would skew here and desync the collective schedule.  Steady-state
+sessions use deterministic trace ids whose sha256 verdict keeps
+exactly 5 of 48 chains in the file lane (>= 4x volume drop by
+construction); one seeded slow flush on a sampled-OUT trace must trip
+the sentinel on both ranks and the tail latch must retroactively
+replay that trace's full buffered chain into the file.  The runner
+compares fence/roofline markers across ranks, asserts zero stalls and
+zero local-fallback rounds, and greps each rank's trace file for the
+latched chain and the steady-state volume ratio.
 """
 
 from __future__ import annotations
@@ -342,6 +359,85 @@ roofmark = ','.join('%s=%s' % (fp, roofs[fp]['bound'])
                     for fp in sorted(roofs))
 print('ATTRIB_LEG_STAGES rank=%d %s' % (rank, ';'.join(sigs)))
 print('ATTRIB_LEG_ROOFS rank=%d %s' % (rank, roofmark))
+"""
+
+
+# SPMD workload for the sampling leg: 48 steady-state serving sessions
+# with deterministic trace ids under RAMBA_ATTRIB=sample:4 +
+# RAMBA_TRACE_SAMPLE=4, then one seeded slow flush on a sampled-OUT
+# trace.  The fence decisions (per-fingerprint flush sequence numbers)
+# and roofline bounds are printed for the runner to compare across
+# ranks; the rank-skewed env fault makes rank 1 slower per execute, so
+# any timing dependence in the sampler would diverge the markers.
+# argv: <rank> <coordinator>.
+_SAMPLING_WORKLOAD = """
+import sys
+import numpy as np
+rank, coord = int(sys.argv[1]), sys.argv[2]
+from ramba_tpu.parallel import distributed
+distributed.initialize(coordinator_address=coord, num_processes=2,
+                       process_id=rank)
+import jax
+assert jax.process_count() == 2, jax.process_count()
+import ramba_tpu as rt
+from ramba_tpu import diagnostics, serve
+from ramba_tpu.observe import attrib, events, registry
+from ramba_tpu.resilience import faults
+assert attrib.fence_enabled() and attrib.sample_every() == 4
+assert events.trace_sample_every() == 4
+# steady state: one-flush sessions with deterministic trace ids; the
+# sha256 head-sampling verdict keeps exactly 5 of these 48 chains
+tids = ['steady-%03d' % i for i in range(48)]
+kept = [t for t in tids if events.trace_sampled_in(t)]
+assert len(kept) == 5, kept
+x = None
+for tid in tids:
+    with serve.Session(trace_id=tid) as s:
+        a = rt.arange(2048) * 2.0 + 1.0
+        x = float(np.asarray(a).sum())
+exp = float((np.arange(2048) * 2.0 + 1.0).sum())
+assert abs(x - exp) <= 1e-5 * abs(exp), (x, exp)
+# seeded slow flush on a sampled-OUT trace: warm the program's rolling
+# p50, then delay one execute on BOTH ranks (faults.active suspends the
+# rank-skew env plan) -> the sentinel fires and the tail latch must
+# replay the whole buffered chain into the file lane
+assert not events.trace_sampled_in('slow-0')
+with serve.Session(trace_id='slow-0') as s:
+    for _ in range(6):
+        b = rt.sqrt(rt.arange(4099) + 1.0)
+        float(np.asarray(b).sum())
+    # 1500 ms: the SPMD gather collective drags rank 1's 40 ms skew into
+    # every flush's wall (~55 ms p50), so the seed must clear 8x THAT
+    with faults.active('execute:delay:ms=1500'):
+        b = rt.sqrt(rt.arange(4099) + 1.0)
+        float(np.asarray(b).sum())
+rt.sync()
+slow = events.last(0, type='slow_flush')
+assert slow, 'seeded slow flush never tripped the sentinel'
+assert slow[-1].get('trace_id') == 'slow-0', slow[-1]
+ring = events.snapshot_ring()
+stalls = sum(1 for e in ring if e.get('type') == 'stall')
+local = sum(1 for e in ring if e.get('type') == 'coherence'
+            and e.get('outcome') == 'local')
+est = sum(1 for e in ring if e.get('type') == 'flush'
+          and e.get('device_source') == 'estimated')
+fen = sum(1 for e in ring if e.get('type') == 'flush'
+          and e.get('device_source') == 'fenced')
+rep = diagnostics.perf_report()
+roofs = (rep.get('attribution') or {}).get('rooflines') or {}
+assert roofs, rep.get('attribution')
+samp = attrib.sampling_report()
+fences = ';'.join(
+    '%s:%s/%d' % (fp, ','.join(str(q) for q in d['fenced_seqs']),
+                  d['calls'])
+    for fp, d in sorted(samp['fingerprints'].items()))
+roofmark = ','.join('%s=%s' % (fp, roofs[fp]['bound'])
+                    for fp in sorted(roofs))
+print('SAMPLING_LEG_FENCES rank=%d %s' % (rank, fences))
+print('SAMPLING_LEG_ROOFS rank=%d %s' % (rank, roofmark))
+print('SAMPLING_LEG_HEALTH rank=%d stalls=%d local=%d est=%d fenced=%d '
+      'latched=%d' % (rank, stalls, local, est, fen,
+                      registry.get('events.tail_latched')))
 """
 
 
@@ -2103,6 +2199,158 @@ def run_attrib_leg() -> int:
     return 0 if ok else 1
 
 
+def run_sampling_leg() -> int:
+    """Two ranks under RAMBA_ATTRIB=sample:4 + RAMBA_TRACE_SAMPLE=4 with
+    a rank-skewed execute:delay fault; the fence sequence numbers and
+    roofline bounds must be identical across ranks (the sampler is
+    count-derived, never timing-derived), the tail latch must replay the
+    seeded slow flush's full chain into each rank's file, and steady-
+    state file volume must drop >= 4x."""
+    import json
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    basetemp = tempfile.mkdtemp(prefix="ramba_2proc_sampling_")
+    trace_base = os.path.join(basetemp, "trace.jsonl")
+    budget = float(os.environ.get("RAMBA_TEST_PROCS_TIMEOUT", "600"))
+
+    procs, logs = [], []
+    for rank in range(2):
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        for k in ("RAMBA_TEST_PROCS", "RAMBA_TEST_PROC_ID",
+                  "RAMBA_TEST_COORD", "RAMBA_TEST_SHARED_TMP",
+                  "RAMBA_PROFILE_DIR", "RAMBA_HBM_BUDGET",
+                  "RAMBA_BASELINE_DIR", "RAMBA_SLO_P95_MS"):
+            env.pop(k, None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["RAMBA_PERF"] = "1"
+        env["RAMBA_TRACE"] = trace_base
+        env["RAMBA_ATTRIB"] = "sample:4"
+        env["RAMBA_TRACE_SAMPLE"] = "4"
+        # slack against scheduler hiccups on the un-delayed rank: only
+        # the seeded 400 ms flush (>= 10x any p50 here) may trip
+        env["RAMBA_SLOW_FLUSH_FACTOR"] = "8"
+        # rank-skewed slowness: same env on BOTH ranks (the per-site
+        # call counter must advance everywhere), fires on rank 1 only
+        env["RAMBA_FAULTS"] = "execute:delay:ms=40:rank=1"
+        # same denominators on both ranks (see attrib leg)
+        env["RAMBA_PEAKS_JSON"] = (
+            '{"default": {"peak_gbps": 100.0, "peak_tflops": 1.0}}')
+        log = open(os.path.join(basetemp, f"rank{rank}.log"), "w")
+        logs.append(log)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _SAMPLING_WORKLOAD, str(rank),
+             f"localhost:{port}"],
+            env=env, stdout=log, stderr=subprocess.STDOUT, cwd=REPO,
+        ))
+
+    deadline = time.time() + budget
+    rcs = [None, None]
+    try:
+        for i, p in enumerate(procs):
+            left = max(5.0, deadline - time.time())
+            try:
+                rcs[i] = p.wait(timeout=left)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                rcs[i] = -9
+    finally:
+        for log in logs:
+            log.close()
+
+    ok = all(rc == 0 for rc in rcs)
+
+    marks = {"SAMPLING_LEG_FENCES": [None, None],
+             "SAMPLING_LEG_ROOFS": [None, None],
+             "SAMPLING_LEG_HEALTH": [None, None]}
+    for rank in range(2):
+        path = os.path.join(basetemp, f"rank{rank}.log")
+        with open(path) as f:
+            tail = f.read().splitlines()
+        for line in tail:
+            for key in marks:
+                if line.startswith(f"{key} rank={rank} "):
+                    marks[key][rank] = line.split(" ", 2)[2]
+        if any(marks[key][rank] is None for key in marks):
+            ok = False
+        print(f"--- sampling leg rank {rank} rc={rcs[rank]} ({path}) ---")
+        print("\n".join(tail[-(4 if ok else 40):]))
+
+    # lockstep proof: identical fence sequence numbers per fingerprint
+    # and identical roofline bounds, despite the rank-1 delay skew
+    for key in ("SAMPLING_LEG_FENCES", "SAMPLING_LEG_ROOFS"):
+        vals = marks[key]
+        if ok and vals[0] != vals[1]:
+            print(f"sampling leg: FAIL ({key} diverges: "
+                  f"r0={vals[0]} r1={vals[1]})")
+            ok = False
+    if ok:
+        for rank in range(2):
+            fields = dict(kv.split("=") for kv
+                          in marks["SAMPLING_LEG_HEALTH"][rank].split())
+            if fields["stalls"] != "0" or fields["local"] != "0":
+                print(f"sampling leg: FAIL (rank {rank} not clean under "
+                      f"skew: {fields})")
+                ok = False
+            if int(fields["est"]) <= 0 or int(fields["fenced"]) <= 0:
+                print(f"sampling leg: FAIL (rank {rank} missing "
+                      f"estimated/fenced spans: {fields})")
+                ok = False
+            if int(fields["latched"]) < 1:
+                print(f"sampling leg: FAIL (rank {rank} tail latch never "
+                      f"fired: {fields})")
+                ok = False
+
+    # file-lane checks per rank: exactly the 5 hash-selected steady
+    # chains on disk (9.6x volume drop), plus the latched slow-0 chain
+    # in full (6 warm flushes + the slow one + the incident line)
+    if ok:
+        for rank in range(2):
+            fpath = f"{trace_base}.rank{rank}"
+            steady_ids, slow_flushes, slow_incident = set(), 0, 0
+            try:
+                with open(fpath) as f:
+                    for line in f:
+                        try:
+                            e = json.loads(line)
+                        except ValueError:
+                            continue
+                        tid = e.get("trace_id") or ""
+                        if tid.startswith("steady-"):
+                            steady_ids.add(tid)
+                        if tid == "slow-0":
+                            if e.get("type") == "flush":
+                                slow_flushes += 1
+                            elif e.get("type") == "slow_flush":
+                                slow_incident += 1
+            except OSError as exc:
+                print(f"sampling leg: FAIL (rank {rank} trace file: {exc})")
+                ok = False
+                continue
+            if len(steady_ids) != 5:
+                print(f"sampling leg: FAIL (rank {rank}: {len(steady_ids)} "
+                      f"steady chains on disk, expected the 5 hash-selected "
+                      f"ones: {sorted(steady_ids)})")
+                ok = False
+            if slow_flushes < 7 or slow_incident < 1:
+                print(f"sampling leg: FAIL (rank {rank}: latched chain "
+                      f"incomplete — {slow_flushes} flush spans, "
+                      f"{slow_incident} slow_flush line(s))")
+                ok = False
+            if ok:
+                print(f"sampling leg rank {rank}: 5/48 steady chains on "
+                      f"disk (9.6x drop), slow-0 chain replayed "
+                      f"({slow_flushes} spans + incident)")
+
+    print(f"two-process sampling leg: {'OK' if ok else 'FAIL'}")
+    if ok:
+        shutil.rmtree(basetemp, ignore_errors=True)
+    return 0 if ok else 1
+
+
 def run_memo_leg() -> int:
     """Two ranks under RAMBA_MEMO=1; both must compute the identical
     canonical hash and hit the result cache in LOCKSTEP (a hit skips
@@ -3346,6 +3594,8 @@ def main() -> int:
         return run_warmstart_leg()
     if "--overload-leg" in sys.argv[1:]:
         return run_overload_leg()
+    if "--sampling-leg" in sys.argv[1:]:
+        return run_sampling_leg()
     pytest_args = sys.argv[1:] or ["tests/"]
     with socket.socket() as s:
         s.bind(("localhost", 0))

@@ -1,0 +1,597 @@
+"""Self-metering observability plane (PR 20).
+
+Covers ``ramba_tpu.observe.observer`` (the observer-tax ledger),
+sampled attribution (``RAMBA_ATTRIB=sample:<N>``), tail-based trace
+retention (``RAMBA_TRACE_SAMPLE``), the buffered JSONL writer, and the
+incident explainer:
+
+* fence sampling is a pure function of the fingerprint's flush sequence
+  number — deterministic, replayable, independent per fingerprint, and
+  the fence stays *armed* (``fence_enabled()``) under sampling,
+* unfenced flushes carry ``device_source:"estimated"`` with a
+  ``device_est_s`` stand-in from the rolling fenced p50, and never a
+  ``device_execute`` stage,
+* the file lane head-samples 1-in-N traces by a deterministic trace-id
+  hash; an incident retroactively latches the chain (tail latch), a
+  rotated buffer leaves a ``trace_gap`` marker,
+* writer overflow/failure is counted (``events.write_dropped`` /
+  ``events.write_errors``), never raised; ring overwrites count
+  ``events.ring_dropped``,
+* the explainer names the dominant divergent stage with an
+  operator-facing verdict for >= 3 distinct dominant-stage scenarios,
+  and the ``slow_flush`` sentinel stamps it onto the event,
+* ``scripts/trace_report.py`` treats estimated-vs-fenced as NOT a rank
+  divergence and renders sampled-out gaps instead of ORPHANED.
+"""
+
+import contextlib
+import json
+import os
+import subprocess
+import sys
+
+import ramba_tpu as rt
+from ramba_tpu import diagnostics
+from ramba_tpu.observe import attrib, events, observer, registry, telemetry
+from ramba_tpu.resilience import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _chain(n=2711):
+    a = rt.arange(n) * 2.0 + 1.0
+    return float(rt.sum(a))
+
+
+@contextlib.contextmanager
+def _env(**kv):
+    saved = {k: os.environ.get(k) for k in kv}
+    for k, v in kv.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    try:
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _counter(name):
+    return registry.snapshot()["counters"].get(name, 0)
+
+
+# ---------------------------------------------------------------------------
+# deterministic fence sampling
+# ---------------------------------------------------------------------------
+
+
+def test_sample_env_parse_keeps_fence_armed():
+    with _env(RAMBA_ATTRIB="sample:4"):
+        attrib.reconfigure()
+        try:
+            assert attrib.fence_enabled()  # armed, just not every call
+            assert attrib.sampling()
+            assert attrib.sample_every() == 4
+        finally:
+            pass
+    attrib.reconfigure()
+    assert not attrib.sampling() and attrib.sample_every() == 1
+
+
+def test_fence_decision_deterministic_and_replayable():
+    with _env(RAMBA_ATTRIB="sample:4"):
+        attrib.reconfigure()
+        attrib.reset()
+        try:
+            fp = "ab" * 6
+            dec = [attrib.fence_decision(fp) for _ in range(9)]
+            assert dec == [True, False, False, False,
+                           True, False, False, False, True]
+            # independent counter per fingerprint: a fresh fp starts at
+            # seq 0, which is ALWAYS fenced (cold kernels get a sample)
+            assert attrib.fence_decision("cd" * 6) is True
+            rep = attrib.sampling_report()
+            assert rep["sample_every"] == 4 and rep["enabled"]
+            assert rep["fingerprints"][fp]["calls"] == 9
+            assert rep["fingerprints"][fp]["fenced_seqs"] == [0, 4, 8]
+            # replay after reset is bit-identical: the verdict is a pure
+            # function of call order, never RNG, never timing — the
+            # property that keeps SPMD ranks in lockstep
+            attrib.reset()
+            assert [attrib.fence_decision(fp) for _ in range(9)] == dec
+        finally:
+            attrib.reset()
+    attrib.reconfigure()
+
+
+def test_fence_decision_stamps_device_source():
+    with _env(RAMBA_ATTRIB="sample:2"):
+        attrib.reconfigure()
+        attrib.reset()
+        try:
+            fp = "ee" * 6
+            s0, s1 = {}, {}
+            assert attrib.fence_decision(fp, s0) is True
+            assert attrib.fence_decision(fp, s1) is False
+            assert s0["device_source"] == "fenced" and s0["fence_seq"] == 0
+            assert s1["device_source"] == "estimated" and s1["fence_seq"] == 1
+            # a segmented flush with any fenced segment reads as fenced
+            attrib.fence_decision(fp, s1)
+            assert s1["device_source"] == "fenced"
+        finally:
+            attrib.reset()
+    attrib.reconfigure()
+
+
+def test_fence_decision_off_and_always_modes():
+    with _env(RAMBA_ATTRIB="off"):
+        attrib.reconfigure()
+        assert attrib.fence_decision("ab" * 6) is False
+    with _env(RAMBA_ATTRIB=None):
+        attrib.reconfigure()
+        # always-on: every call fences, no sequence bookkeeping
+        assert all(attrib.fence_decision("ab" * 6) for _ in range(3))
+        assert not attrib.sampling()
+    attrib.reconfigure()
+
+
+def test_estimated_device_source_on_real_flushes():
+    with _env(RAMBA_ATTRIB="sample:2", RAMBA_PERF="1"):
+        attrib.reconfigure()
+        attrib.reset()
+        try:
+            for _ in range(6):
+                _chain(3301)
+            spans = [s for s in diagnostics.last_flushes(6)
+                     if s.get("device_source")]
+            srcs = {s["device_source"] for s in spans}
+            assert {"fenced", "estimated"} <= srcs, spans
+            for s in spans:
+                if s["device_source"] == "estimated":
+                    # the estimate is display-only: never a stage (the
+                    # device tail genuinely overlaps the host unfenced)
+                    assert "device_execute" not in s.get("stages", {}), s
+                    assert s.get("fence_seq") is not None
+            # once a fenced steady-state sample exists, unfenced flushes
+            # carry the rolling fenced p50 as device_est_s
+            est = [s for s in spans if s["device_source"] == "estimated"
+                   and s.get("device_est_s") is not None]
+            assert est, spans
+            for s in est:
+                assert s["device_est_s"] > 0
+            # the report carries the sampling block under sampling mode
+            rep = attrib.attribution_report()
+            assert rep["sampling"]["sample_every"] == 2
+            assert rep["sampling"]["fingerprints"]
+        finally:
+            attrib.reset()
+    attrib.reconfigure()
+
+
+def test_estimated_device_s_needs_fenced_history():
+    attrib.reset()
+    assert attrib.estimated_device_s("99" * 6) is None
+    assert attrib.estimated_device_s(None) is None
+    attrib.record_device("99" * 6, "prog_x", 0.004)
+    attrib.record_device("99" * 6, "prog_x", 0.006)
+    est = attrib.estimated_device_s("99" * 6)
+    assert est is not None and 0.004 <= est <= 0.006
+    attrib.reset()
+
+
+# ---------------------------------------------------------------------------
+# tail-based trace retention + buffered writer
+# ---------------------------------------------------------------------------
+
+
+def _pick_tid(sampled_in, start=0):
+    """First trace id (deterministic hash) with the wanted verdict."""
+    i = start
+    while True:
+        tid = f"t-{i:04d}"
+        if events.trace_sampled_in(tid) == sampled_in:
+            return tid
+        i += 1
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def test_tail_latch_replays_buffered_chain(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    events.configure(path, sample=4)
+    try:
+        tid_out = _pick_tid(False)
+        tid_in = _pick_tid(True)
+        b0 = _counter("events.tail_buffered")
+        l0 = _counter("events.tail_latched")
+        for i in range(3):
+            events.emit({"type": "flush", "label": "prog_t", "i": i,
+                         "trace_id": tid_out})
+        events.emit({"type": "flush", "label": "prog_t", "i": 99,
+                     "trace_id": tid_in})
+        events.sync()
+        evs = _read_jsonl(path)
+        # steady state: the sampled-out chain is buffered, not written;
+        # the sampled-in chain writes through
+        assert [e.get("trace_id") for e in evs] == [tid_in]
+        assert _counter("events.tail_buffered") == b0 + 3
+        # incident: the chain is latched and replayed IN ORDER ahead of
+        # the incident line
+        events.emit({"type": "slow_flush", "label": "prog_t",
+                     "trace_id": tid_out})
+        events.sync()
+        chain = [e for e in _read_jsonl(path)
+                 if e.get("trace_id") == tid_out]
+        assert [e.get("i") for e in chain[:3]] == [0, 1, 2]
+        assert chain[3]["type"] == "slow_flush"
+        assert _counter("events.tail_latched") == l0 + 1
+        # later events of a latched trace write through unsampled
+        events.emit({"type": "flush", "label": "prog_t", "i": 7,
+                     "trace_id": tid_out})
+        events.sync()
+        chain = [e for e in _read_jsonl(path)
+                 if e.get("trace_id") == tid_out]
+        assert chain[-1].get("i") == 7
+        # events with NO trace id always write through
+        events.emit({"type": "health", "source": "x", "outcome": "ok"})
+        events.sync()
+        assert any(e.get("type") == "health" for e in _read_jsonl(path))
+    finally:
+        events.configure(None)
+
+
+def test_tail_buffer_rotation_leaves_gap_marker(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    events.configure(path, sample=4)
+    try:
+        tid = _pick_tid(False)
+        n = 70  # > the 64-event per-trace buffer: 6 oldest rotate out
+        for i in range(n):
+            events.emit({"type": "flush", "label": "prog_g", "i": i,
+                         "trace_id": tid})
+        events.emit({"type": "slow_flush", "label": "prog_g",
+                     "trace_id": tid})
+        events.sync()
+        evs = [e for e in _read_jsonl(path) if e.get("trace_id") == tid]
+        gaps = [e for e in evs if e.get("type") == "trace_gap"]
+        assert len(gaps) == 1 and gaps[0]["dropped"] == n - 64, gaps
+        kept = [e.get("i") for e in evs if e.get("type") == "flush"]
+        assert kept == list(range(n - 64, n))  # newest 64 survive
+    finally:
+        events.configure(None)
+
+
+def test_buffered_writer_overflow_drops_counted(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    events.configure(path, buffer_max=4)
+    try:
+        d0 = _counter("events.write_dropped")
+        # hold the writer lock: drains can't run, the pending buffer
+        # fills to buffer_max and further lines drop (counted, no raise,
+        # no blocking — the writer must never backpressure the flush)
+        with events._write_lock:
+            for i in range(10):
+                events.emit({"type": "bench_tick", "i": i})
+        events.sync()
+        assert _counter("events.write_dropped") >= d0 + 6
+        assert len(_read_jsonl(path)) <= 4
+    finally:
+        events.configure(None)
+
+
+def test_write_errors_counted_not_raised(tmp_path, monkeypatch):
+    path = str(tmp_path / "t.jsonl")
+    events.configure(path)
+    try:
+        class _Bad:
+            def write(self, s):
+                raise OSError("disk full")
+
+        monkeypatch.setattr(events, "_file", lambda: _Bad())
+        e0 = _counter("events.write_errors")
+        events.emit({"type": "bench_tick", "i": 0})
+        events.sync()  # must not raise
+        assert _counter("events.write_errors") >= e0 + 1
+    finally:
+        monkeypatch.undo()
+        events.configure(None)
+
+
+def test_ring_dropped_counter():
+    events.configure(None)
+    r0 = _counter("events.ring_dropped")
+    n = events.ring.maxlen + 10
+    for i in range(n):
+        events.emit({"type": "bench_tick", "i": i})
+    assert _counter("events.ring_dropped") >= r0 + 10
+
+
+def test_trace_sampled_in_deterministic():
+    events.configure(None, sample=4)
+    try:
+        tids = [f"t-{i:04d}" for i in range(64)]
+        verdicts = [events.trace_sampled_in(t) for t in tids]
+        assert any(verdicts) and not all(verdicts)
+        # pure hash: same answer on every call (and on every rank)
+        assert [events.trace_sampled_in(t) for t in tids] == verdicts
+        # no trace id -> always in; sample 1 -> everything in
+        assert events.trace_sampled_in(None)
+    finally:
+        events.configure(None)
+    assert all(events.trace_sampled_in(t) for t in ("a", "b", "c"))
+
+
+# ---------------------------------------------------------------------------
+# observer-tax ledger
+# ---------------------------------------------------------------------------
+
+
+def test_observer_ledger_accounting():
+    observer.reset()
+    observer.add("events", 0.002)
+    observer.add("events", 0.001)
+    observer.add("fence", 0.004)
+    observer.add("fence", -1.0)  # negative clock skew: ignored
+    with observer.taxed("telemetry"):
+        pass
+    snap = observer.snapshot()
+    comps = snap["components"]
+    assert comps["events"]["count"] == 2
+    assert abs(comps["events"]["seconds"] - 0.003) < 1e-9
+    assert comps["fence"]["count"] == 1
+    assert comps["telemetry"]["count"] == 1
+    assert snap["total_s"] >= 0.007
+    observer.reset()
+    assert observer.snapshot()["components"] == {}
+
+
+def test_observer_tax_frac_denominator_is_flush_wall():
+    observer.reset()
+    attrib.reset()
+    assert observer.tax_frac() is None  # no attributed wall yet
+    _chain(3307)  # one real flush: attrib totals + emit/ledger billing
+    frac = observer.tax_frac()
+    assert frac is not None and 0.0 < frac
+    snap = observer.snapshot()
+    assert snap.get("tax_frac") == frac
+    # the flush itself billed the plane's components
+    assert "events" in snap["components"]
+    assert "ledger" in snap["components"]
+    attrib.reset()
+    observer.reset()
+
+
+def test_observer_surfaces_in_diagnostics_and_telemetry():
+    observer.reset()
+    observer.add("fleet", 0.001)
+    rep = diagnostics.observer_report()
+    assert rep["components"]["fleet"]["seconds"] > 0
+    assert "observer" in diagnostics.snapshot()
+    import io
+    buf = io.StringIO()
+    diagnostics.report(file=buf)
+    assert "observer tax" in buf.getvalue()
+    prom = telemetry.render()
+    line = next(ln for ln in prom.splitlines()
+                if ln.startswith("ramba_observer_seconds_total{"))
+    assert 'component="fleet"' in line  # (rank label rides along)
+    observer.reset()
+
+
+# ---------------------------------------------------------------------------
+# incident explainer
+# ---------------------------------------------------------------------------
+
+
+def _seed_baseline(fp, n=5):
+    """Five steady spans -> per-stage rolling baselines for ``fp``."""
+    for _ in range(n):
+        span = {"stages": {"prepare": 0.001, "queue_wait": 0.001,
+                           "dispatch": 0.004, "device_execute": 0.004},
+                "wall_s": 0.011}
+        attrib.finalize_span(span, fp=fp)
+
+
+def test_explainer_verdicts_three_dominant_stages():
+    attrib.reset()
+    try:
+        fp = "fe" * 6
+        _seed_baseline(fp)
+        # 1: queue_wait 12x baseline -> overload
+        why = attrib.explain(
+            {"stages": {"prepare": 0.001, "queue_wait": 0.012,
+                        "dispatch": 0.004, "device_execute": 0.004},
+             "wall_s": 0.022, "fingerprint": fp})
+        assert why["stage"] == "queue_wait"
+        assert why["verdict"] == "overload"
+        assert 11.0 <= why["ratio"] <= 13.0
+        assert "12.0x baseline -> overload" in why["text"]
+        # 2: compile appearing on a steady-state fingerprint (no
+        # baseline window at all) -> cache miss, divergent by existence
+        why = attrib.explain(
+            {"stages": {"prepare": 0.001, "queue_wait": 0.001,
+                        "compile": 0.050, "dispatch": 0.004,
+                        "device_execute": 0.004},
+             "wall_s": 0.061, "fingerprint": fp})
+        assert why["stage"] == "compile"
+        assert why["verdict"] == "cache miss"
+        assert why["ratio"] is None and "compile -> cache miss" in why["text"]
+        # 3: device_execute dominates -> device regression (explicit fp
+        # argument wins over the span stamp)
+        why = attrib.explain(
+            {"stages": {"prepare": 0.001, "queue_wait": 0.001,
+                        "dispatch": 0.004, "device_execute": 0.040},
+             "wall_s": 0.047}, fp=fp)
+        assert why["stage"] == "device_execute"
+        assert why["verdict"] == "device regression"
+        # 4: unattributed residual blowing up -> untracked interference
+        why = attrib.explain(
+            {"stages": {"prepare": 0.001, "queue_wait": 0.001,
+                        "dispatch": 0.004, "device_execute": 0.004},
+             "unattributed_s": 0.030, "wall_s": 0.041, "fingerprint": fp})
+        assert why["stage"] == "unattributed"
+        assert "untracked interference" in why["verdict"]
+    finally:
+        attrib.reset()
+
+
+def test_explainer_silent_without_divergence_or_history():
+    attrib.reset()
+    try:
+        fp = "fd" * 6
+        # no baselines at all -> None (nothing to diff against)
+        assert attrib.explain(
+            {"stages": {"prepare": 0.001}, "wall_s": 0.001,
+             "fingerprint": fp}) is None
+        _seed_baseline(fp)
+        # a span AT baseline -> None (no stage exceeds 1.5x its p50)
+        assert attrib.explain(
+            {"stages": {"prepare": 0.001, "queue_wait": 0.001,
+                        "dispatch": 0.004, "device_execute": 0.004},
+             "wall_s": 0.011, "fingerprint": fp}) is None
+        # no fingerprint -> None
+        assert attrib.explain(
+            {"stages": {"prepare": 0.9}, "wall_s": 1.0}) is None
+    finally:
+        attrib.reset()
+
+
+def test_slow_flush_event_carries_why_verdict():
+    attrib.reset()
+    with _env(RAMBA_SLOW_FLUSH_FACTOR="4", RAMBA_PERF="1"):
+        from ramba_tpu.observe import ledger
+        ledger.reconfigure()
+        try:
+            for _ in range(6):
+                _chain(4201)
+            base = len(events.last(0, type="slow_flush"))
+            with faults.active("execute:delay:ms=200"):
+                _chain(4201)
+            evs = events.last(0, type="slow_flush")
+            assert len(evs) == base + 1, evs[-2:]
+            ev = evs[-1]
+            # the explainer stamped the sentinel event with its verdict
+            assert ev.get("why") and ev.get("why_stage") in (
+                attrib.STAGES + ("unattributed",))
+            assert ev.get("why_verdict") == attrib._EXPLAIN_VERDICTS[
+                ev["why_stage"]]
+            assert ev["why"].endswith(ev["why_verdict"])
+        finally:
+            attrib.reset()
+    from ramba_tpu.observe import ledger
+    ledger.reconfigure()
+
+
+# ---------------------------------------------------------------------------
+# trace_report: estimated spans + sampled-out gaps
+# ---------------------------------------------------------------------------
+
+
+def _write_jsonl(path, events_):
+    with open(path, "w") as f:
+        for e in events_:
+            f.write(json.dumps(e) + "\n")
+
+
+def _trace_report(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "trace_report.py"),
+         *args],
+        capture_output=True, text=True,
+    )
+
+
+def test_merge_ranks_estimated_is_not_divergence(tmp_path):
+    base = tmp_path / "m.jsonl"
+    # rank 0 fenced (full waterfall), rank 1 sampled out at the same
+    # flush index: no device_execute stage, but device_source says why
+    _write_jsonl(f"{base}.rank0", [
+        {"type": "flush", "label": "prog_a", "ts": 10.1, "seq": 1,
+         "rank": 0, "wall_s": 0.01, "cache": "hit",
+         "device_source": "fenced", "unattributed_s": 0.001,
+         "stages": {"prepare": 0.002, "dispatch": 0.003,
+                    "device_execute": 0.004}},
+    ])
+    _write_jsonl(f"{base}.rank1", [
+        {"type": "flush", "label": "prog_a", "ts": 10.1, "seq": 1,
+         "rank": 1, "wall_s": 0.01, "cache": "hit",
+         "device_source": "estimated", "device_est_s": 0.004,
+         "unattributed_s": 0.005,
+         "stages": {"prepare": 0.002, "dispatch": 0.003}},
+    ])
+    r = _trace_report(str(base), "--merge-ranks")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "rank divergence: none" in r.stdout
+    # ...but a genuinely MISSING fence (no device_source alibi) at the
+    # same index still flags — sampling must not mask real skew
+    _write_jsonl(f"{base}.rank1", [
+        {"type": "flush", "label": "prog_a", "ts": 10.1, "seq": 1,
+         "rank": 1, "wall_s": 0.01, "cache": "hit",
+         "unattributed_s": 0.005,
+         "stages": {"prepare": 0.002, "dispatch": 0.003}},
+    ])
+    r2 = _trace_report(str(base), "--merge-ranks")
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "rank divergence at flush #0" in r2.stdout
+
+
+def test_attrib_report_renders_estimated_spans(tmp_path):
+    path = tmp_path / "t.jsonl"
+    _write_jsonl(path, [
+        {"type": "flush", "label": "prog_a", "ts": 1.0, "seq": 1,
+         "wall_s": 0.01, "unattributed_s": 0.001,
+         "device_source": "fenced",
+         "stages": {"prepare": 0.002, "dispatch": 0.003,
+                    "device_execute": 0.004}},
+        {"type": "flush", "label": "prog_a", "ts": 1.1, "seq": 2,
+         "wall_s": 0.01, "unattributed_s": 0.005,
+         "device_source": "estimated", "device_est_s": 0.0042,
+         "stages": {"prepare": 0.002, "dispatch": 0.003}},
+        {"type": "slow_flush", "label": "prog_a", "ts": 1.2, "seq": 3,
+         "why": "queue_wait 12.0x baseline -> overload",
+         "why_stage": "queue_wait", "why_verdict": "overload"},
+    ])
+    r = _trace_report(str(path), "--attrib")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "sampled attribution: 1 fenced / 1 estimated" in r.stdout
+    assert "(est)" in r.stdout
+    assert "incident explainer verdicts" in r.stdout
+    assert "queue_wait 12.0x baseline -> overload" in r.stdout
+
+
+def test_trace_chain_gap_classified_not_orphaned(tmp_path):
+    path = tmp_path / "t.jsonl"
+    # chain whose early spans rotated out of the tail buffer: the child
+    # event's parent is gone, but the trace_gap marker explains why
+    _write_jsonl(path, [
+        {"type": "trace_gap", "trace_id": "req-1", "dropped": 6,
+         "reason": "tail_buffer_rotation", "ts": 1.0, "seq": 1},
+        {"type": "flush", "label": "prog_a", "ts": 1.1, "seq": 2,
+         "trace_id": "req-1", "span_id": "s2", "wall_s": 0.01},
+        {"type": "degrade", "action": "rung", "ts": 1.2, "seq": 3,
+         "trace_id": "req-1", "parent_span": "s-rotated-out"},
+    ])
+    r = _trace_report(str(path), "--trace", "req-1")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "sampling gap: 6 event(s)" in r.stdout
+    assert "sampled-out events (1)" in r.stdout
+    assert "ORPHANED" not in r.stdout
+    # without a gap marker the same shape is a genuine orphan
+    _write_jsonl(path, [
+        {"type": "flush", "label": "prog_a", "ts": 1.1, "seq": 1,
+         "trace_id": "req-2", "span_id": "s2", "wall_s": 0.01},
+        {"type": "degrade", "action": "rung", "ts": 1.2, "seq": 2,
+         "trace_id": "req-2", "parent_span": "s-missing-rank"},
+    ])
+    r2 = _trace_report(str(path), "--trace", "req-2")
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "ORPHANED events (1)" in r2.stdout
+    assert "sampling gap" not in r2.stdout
